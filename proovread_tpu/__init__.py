@@ -1,0 +1,30 @@
+"""proovread_tpu — TPU-native hybrid long-read error correction.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of
+proovread (Hackl et al. 2014, Bioinformatics btu392; reference at
+/root/reference): correct noisy PacBio long reads by iteratively
+mapping accurate Illumina short reads onto them, calling per-column
+weighted-majority consensus, masking corrected (high-confidence)
+regions and re-mapping with progressively stricter parameters.
+
+Where the reference is a Perl orchestration of native CPU mappers
+(bwa-proovread, BLASR, SHRiMP2) + samtools communicating through
+files, this framework is a single process:
+
+- ``io``        host data plane: FASTQ/FASTA/SAM codecs, batching/bucketing
+- ``ops``       device kernels: encoding, k-mer seeding, banded Smith-
+                Waterman (Pallas), pileup scatter, consensus argmax, entropy
+- ``align``     seed → extend → per-bin admission (the bwa-proovread
+                ``-b/-l`` trick as a device-side top-k)
+- ``consensus`` the pileup/state-matrix engine (Sam::Seq equivalent)
+- ``filters``   ncscore / repeat / containment / coverage filters,
+                phred-masking, window trimming, chimera entropy detector
+- ``pipeline``  the iterative driver: modes → task lists, masking loop,
+                shortcutting, ccs preprocessing, siamaera trimming, CLI
+- ``parallel``  mesh construction, shardings, multi-host input sharding
+- ``compat``    SAM/BAM + proovread.cfg interop adapters
+"""
+
+__version__ = "0.1.0"
+
+from proovread_tpu.io.records import SeqRecord  # noqa: F401

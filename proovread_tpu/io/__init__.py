@@ -1,0 +1,16 @@
+"""Host-side data plane: sequence records, FASTA/FASTQ/SAM codecs, batching."""
+
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.io.fasta import FastaReader, FastaWriter
+from proovread_tpu.io.fastq import FastqReader, FastqWriter
+from proovread_tpu.io.batch import ReadBatch, pack_reads
+
+__all__ = [
+    "SeqRecord",
+    "FastaReader",
+    "FastaWriter",
+    "FastqReader",
+    "FastqWriter",
+    "ReadBatch",
+    "pack_reads",
+]

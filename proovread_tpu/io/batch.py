@@ -1,0 +1,116 @@
+"""Padded/bucketed read batches — the host→device boundary.
+
+Reads become dense tensors: codes int8 [B, L], qual uint8 [B, L], lengths
+int32 [B]. Bucketing by length keeps XLA shapes static (a handful of compiled
+programs) while bounding padding waste; this replaces the reference's
+byte-offset file chunking (``bin/proovread:1493-1501``) as the unit of work
+distribution.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops.encode import N, decode_codes, encode_ascii
+
+DEFAULT_FALLBACK_PHRED = 1  # reference Sam::Seq FallbackPhred (Sam/Seq.pm:113-128)
+
+
+@dataclass
+class ReadBatch:
+    """A fixed-shape batch of reads."""
+
+    ids: List[str]
+    codes: np.ndarray      # int8  [B, L]
+    qual: np.ndarray       # uint8 [B, L]
+    lengths: np.ndarray    # int32 [B]
+    descs: List[str] = field(default_factory=list)
+
+    @property
+    def batch_size(self) -> int:
+        return self.codes.shape[0]
+
+    @property
+    def pad_len(self) -> int:
+        return self.codes.shape[1]
+
+    def position_mask(self) -> np.ndarray:
+        """bool [B, L]: True at valid (non-padding) positions."""
+        return np.arange(self.pad_len)[None, :] < self.lengths[:, None]
+
+    def record(self, i: int) -> SeqRecord:
+        L = int(self.lengths[i])
+        return SeqRecord(
+            id=self.ids[i],
+            seq=decode_codes(self.codes[i, :L]),
+            qual=self.qual[i, :L].copy(),
+            desc=self.descs[i] if self.descs else "",
+        )
+
+    def to_records(self) -> List[SeqRecord]:
+        return [self.record(i) for i in range(self.batch_size)]
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def pack_reads(
+    records: Sequence[SeqRecord],
+    pad_len: Optional[int] = None,
+    pad_multiple: int = 128,
+    fallback_phred: int = DEFAULT_FALLBACK_PHRED,
+) -> ReadBatch:
+    """Pack records into one padded batch.
+
+    ``pad_len`` defaults to max length rounded up to ``pad_multiple`` (lane
+    alignment for TPU tiling). FASTA records get ``fallback_phred`` quals,
+    matching the reference's FallbackPhred for qual-less input."""
+    B = len(records)
+    maxlen = max((len(r) for r in records), default=0)
+    L = pad_len if pad_len is not None else max(pad_multiple, _round_up(maxlen, pad_multiple))
+    if maxlen > L:
+        raise ValueError(f"pad_len {L} < longest read {maxlen}")
+    codes = np.full((B, L), N, dtype=np.int8)
+    qual = np.zeros((B, L), dtype=np.uint8)
+    lengths = np.zeros(B, dtype=np.int32)
+    for i, r in enumerate(records):
+        n = len(r)
+        codes[i, :n] = encode_ascii(r.seq)
+        qual[i, :n] = r.qual if r.qual is not None else fallback_phred
+        lengths[i] = n
+    return ReadBatch(
+        ids=[r.id for r in records],
+        codes=codes,
+        qual=qual,
+        lengths=lengths,
+        descs=[r.desc for r in records],
+    )
+
+
+def bucket_by_length(
+    records: Sequence[SeqRecord],
+    bucket_bounds: Sequence[int] = (256, 512, 1024, 2048, 4096, 8192, 16384, 32768, 65536),
+    batch_size: Optional[int] = None,
+) -> List[ReadBatch]:
+    """Group reads into length buckets, then pack each bucket (optionally
+    splitting into ``batch_size`` chunks). Bounds are pad lengths; reads longer
+    than the last bound get a dedicated rounded-up bucket."""
+    bounds = sorted(bucket_bounds)
+    groups: Dict[int, List[SeqRecord]] = {}
+    for r in records:
+        i = bisect.bisect_left(bounds, len(r))
+        pad = bounds[i] if i < len(bounds) else _round_up(len(r), bounds[-1])
+        groups.setdefault(pad, []).append(r)
+    batches: List[ReadBatch] = []
+    for pad in sorted(groups):
+        recs = groups[pad]
+        step = batch_size or len(recs)
+        for j in range(0, len(recs), step):
+            batches.append(pack_reads(recs[j : j + step], pad_len=pad))
+    return batches

@@ -1,0 +1,11 @@
+"""Device-side kernels and numeric ops (JAX/XLA/Pallas)."""
+
+from proovread_tpu.ops.encode import (
+    A, C, G, T, N, GAP, N_STATES,
+    encode_ascii, decode_codes, revcomp_codes,
+)
+
+__all__ = [
+    "A", "C", "G", "T", "N", "GAP", "N_STATES",
+    "encode_ascii", "decode_codes", "revcomp_codes",
+]

@@ -1,0 +1,270 @@
+"""M0 data-plane tests: records, FASTA/FASTQ codecs, batching.
+
+Mirrors the reference's unit coverage (t/01fasta_seq.t, t/02fasta_parser.t,
+t/03fastq_seq.t) with self-generated fixtures."""
+
+import io
+import random
+
+import numpy as np
+import pytest
+
+from proovread_tpu.io import (
+    FastaReader,
+    FastaWriter,
+    FastqReader,
+    FastqWriter,
+    SeqRecord,
+    pack_reads,
+)
+from proovread_tpu.io.batch import bucket_by_length
+from proovread_tpu.io.fastq import check_format
+from proovread_tpu.io.records import runs_from_bool
+from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
+
+
+def synth_record(rng, ident, n, with_qual=True):
+    seq = "".join(rng.choice("ACGT") for _ in range(n))
+    qual = np.array([rng.randrange(0, 41) for _ in range(n)], dtype=np.uint8) if with_qual else None
+    return SeqRecord(id=ident, seq=seq, qual=qual, desc=f"len={n}")
+
+
+# -- records -----------------------------------------------------------------
+
+def test_record_revcomp_roundtrip():
+    r = SeqRecord("x", "ACGTNacgt", qual=np.arange(9, dtype=np.uint8))
+    rc = r.reverse_complement()
+    assert rc.seq == "acgtNACGT"
+    assert rc.qual.tolist() == list(range(9))[::-1]
+    assert rc.reverse_complement().seq == r.seq
+
+
+def test_record_substr_annotation():
+    r = SeqRecord("x", "AACCGGTT", qual=np.arange(8, dtype=np.uint8))
+    s = r.substr(2, 4)
+    assert s.seq == "CCGG"
+    assert s.qual.tolist() == [2, 3, 4, 5]
+    assert "SUBSTR:2,4" in s.desc
+
+
+def test_record_substr_batch_ids():
+    r = SeqRecord("x", "AACCGGTT")
+    parts = r.substr_batch([(0, 3), (5, 3)])
+    assert [p.id for p in parts] == ["x.1", "x.2"]
+    assert [p.seq for p in parts] == ["AAC", "GTT"]
+
+
+def test_record_mask_and_runs():
+    r = SeqRecord("x", "ACGTACGTAC", qual=np.array([5, 5, 30, 30, 30, 5, 5, 5, 30, 5], dtype=np.uint8))
+    masked = r.mask_seq(r.qual_runs(20, 40, min_len=2))
+    assert masked.seq == "ACNNNCGTAC"  # lone q30 at pos 8 below min_len
+    assert r.qual_runs(0, 10) == [(0, 2), (5, 3), (9, 1)]
+
+
+def test_record_upper_acgtn():
+    assert SeqRecord("x", "acGtRYxn-").upper_acgtn().seq == "ACGTNNNNN"
+
+
+def test_record_qual_str_roundtrip():
+    r = SeqRecord.from_qual_str("x", "ACGT", "!I5#", offset=33)
+    assert r.qual.tolist() == [0, 40, 20, 2]
+    assert r.qual_str(33) == "!I5#"
+
+
+def test_pacbio_meta():
+    r = SeqRecord("m130608_031549_42129_c100/12345/0_5000", "ACGT")
+    m = r.pacbio_meta()
+    assert m["hole"] == 12345 and m["span"] == (0, 5000)
+    assert SeqRecord("read1", "ACGT").pacbio_meta() is None
+
+
+def test_runs_from_bool_edges():
+    assert runs_from_bool(np.array([], dtype=bool)) == []
+    assert runs_from_bool(np.array([True, True, False, True])) == [(0, 2), (3, 1)]
+
+
+# -- fasta -------------------------------------------------------------------
+
+def test_fasta_roundtrip(tmp_path):
+    rng = random.Random(1)
+    recs = [synth_record(rng, f"r{i}", rng.randrange(10, 200), with_qual=False) for i in range(20)]
+    p = tmp_path / "x.fa"
+    with FastaWriter(str(p), line_width=60) as w:
+        for r in recs:
+            w.write(r)
+    got = list(FastaReader(str(p)))
+    assert [g.id for g in got] == [r.id for r in recs]
+    assert [g.seq for g in got] == [r.seq for r in recs]
+    assert got[0].desc == recs[0].desc
+
+
+def test_fasta_seek_resync(tmp_path):
+    p = tmp_path / "x.fa"
+    offs = []
+    with FastaWriter(str(p)) as w:
+        for i in range(10):
+            offs.append(w.write(SeqRecord(f"r{i}", "ACGT" * (i + 1))))
+    rd = FastaReader(str(p))
+    rd.seek(offs[4] + 1)  # mid-record: resync lands on next record
+    assert next(rd).id == "r5"
+
+
+def test_fasta_sample_and_count(tmp_path):
+    p = tmp_path / "x.fa"
+    with FastaWriter(str(p)) as w:
+        for i in range(50):
+            w.write(SeqRecord(f"r{i}", "ACGTACGT"))
+    rd = FastaReader(str(p))
+    s = rd.sample(10, seed=3)
+    assert len(s) == 10 and len({r.id for r in s}) == 10
+    assert rd.estimate_count() == 50
+
+
+# -- fastq -------------------------------------------------------------------
+
+def test_fastq_roundtrip(tmp_path):
+    rng = random.Random(2)
+    recs = [synth_record(rng, f"q{i}", rng.randrange(5, 300)) for i in range(30)]
+    p = tmp_path / "x.fq"
+    with FastqWriter(str(p)) as w:
+        for r in recs:
+            w.write(r)
+    got = list(FastqReader(str(p)))
+    assert [g.id for g in got] == [r.id for r in recs]
+    for g, r in zip(got, recs):
+        assert g.seq == r.seq and g.qual.tolist() == r.qual.tolist()
+
+
+def test_fastq_gzip(tmp_path):
+    import gzip
+
+    p = tmp_path / "x.fq.gz"
+    with gzip.open(p, "wb") as fh:
+        fh.write(b"@a\nACGT\n+\nIIII\n@b\nGGTT\n+\n!!!!\n")
+    got = list(FastqReader(str(p)))
+    assert [g.id for g in got] == ["a", "b"]
+    assert got[1].qual.tolist() == [0, 0, 0, 0]
+
+
+def test_fastq_seek_resync_quality_at(tmp_path):
+    # quality lines full of '@' must not fool the resync
+    p = tmp_path / "t.fq"
+    recs = [SeqRecord(f"q{i}", "ACGTACGTAC", qual=np.full(10, ord("@") - 33, np.uint8)) for i in range(20)]
+    offs = []
+    with FastqWriter(str(p)) as w:
+        for r in recs:
+            offs.append(w.write(r))
+    rd = FastqReader(str(p), phred_offset=33)
+    rd.seek(offs[7] + 3)
+    nxt = next(rd)
+    assert nxt.id == "q8"
+
+
+def test_fastq_seek_exact_offset(tmp_path):
+    # offsets returned by FastqWriter.write must land on that exact record
+    p = tmp_path / "t.fq"
+    recs = [SeqRecord(f"q{i}", "ACGTACGTAC", qual=np.full(10, ord("@") - 33, np.uint8)) for i in range(20)]
+    with FastqWriter(str(p)) as w:
+        offs = [w.write(r) for r in recs]
+    rd = FastqReader(str(p), phred_offset=33)
+    for i in (0, 7, 19):
+        rd.seek(offs[i])
+        assert next(rd).id == f"q{i}"
+
+
+def test_fasta_sample_preserves_iteration(tmp_path):
+    p = tmp_path / "x.fa"
+    with FastaWriter(str(p)) as w:
+        for i in range(10):
+            w.write(SeqRecord(f"r{i}", "ACGT"))
+    rd = FastaReader(str(p))
+    assert next(rd).id == "r0"  # buffers r1's header in _pending
+    rd.sample(3)
+    assert next(rd).id == "r1"  # sampling must not lose the pending record
+
+
+def test_gzip_sample_and_count(tmp_path):
+    import gzip
+
+    p = tmp_path / "z.fq.gz"
+    with gzip.open(p, "wb") as fh:
+        for i in range(25):
+            fh.write(f"@g{i}\nACGT\n+\nIIII\n".encode())
+    rd = FastqReader(str(p), phred_offset=33)
+    assert rd.estimate_count() == 25
+    s = rd.sample(5, seed=1)
+    assert len(s) == 5
+
+
+def test_fasta_estimate_count_bytesio():
+    rd = FastaReader(io.BytesIO(b">a\nACGT\n>b\nGGTT\n"))
+    assert rd.estimate_count() == 2
+
+
+def test_check_format_rejects_stream():
+    with pytest.raises(TypeError):
+        check_format("-")
+
+
+def test_fastq_guess_phred_offset(tmp_path):
+    p33 = tmp_path / "a.fq"
+    with FastqWriter(str(p33), phred_offset=33) as w:
+        w.write(SeqRecord("a", "ACGT", qual=np.array([2, 2, 40, 40], np.uint8)))
+    assert FastqReader(str(p33)).guess_phred_offset() == 33
+    p64 = tmp_path / "b.fq"
+    with FastqWriter(str(p64), phred_offset=64) as w:
+        for i in range(5):
+            w.write(SeqRecord(f"b{i}", "ACGT", qual=np.array([10, 20, 30, 40], np.uint8)))
+    assert FastqReader(str(p64)).guess_phred_offset() == 64
+
+
+def test_fastq_malformed_raises(tmp_path):
+    p = tmp_path / "bad.fq"
+    p.write_bytes(b"@a\nACGT\nOOPS\nIIII\n")
+    with pytest.raises(ValueError):
+        list(FastqReader(str(p), phred_offset=33))
+
+
+def test_check_format(tmp_path):
+    fa = tmp_path / "x.fa"
+    fa.write_bytes(b">a\nACGT\n")
+    fq = tmp_path / "x.fq"
+    fq.write_bytes(b"@a\nACGT\n+\nIIII\n")
+    assert check_format(str(fa)) == "fasta"
+    assert check_format(str(fq)) == "fastq"
+
+
+# -- encoding & batching -----------------------------------------------------
+
+def test_encode_decode_roundtrip():
+    s = "ACGTNACGT"
+    assert decode_codes(encode_ascii(s)) == s
+    assert decode_codes(revcomp_codes(encode_ascii("AACGT"))) == "ACGTT"
+    assert decode_codes(encode_ascii("acgtRY")) == "ACGTNN"
+
+
+def test_pack_reads_shapes_and_roundtrip():
+    rng = random.Random(3)
+    recs = [synth_record(rng, f"r{i}", rng.randrange(1, 200)) for i in range(17)]
+    b = pack_reads(recs, pad_multiple=128)
+    assert b.codes.shape == (17, 128) if max(len(r) for r in recs) <= 128 else True
+    assert b.codes.shape == b.qual.shape
+    assert b.position_mask().sum() == sum(len(r) for r in recs)
+    back = b.to_records()
+    for r, g in zip(recs, back):
+        assert g.seq == r.seq and g.qual.tolist() == r.qual.tolist()
+
+
+def test_pack_reads_fasta_fallback_phred():
+    b = pack_reads([SeqRecord("a", "ACGT")], fallback_phred=7)
+    assert b.qual[0, :4].tolist() == [7, 7, 7, 7]
+
+
+def test_bucket_by_length():
+    rng = random.Random(4)
+    recs = [synth_record(rng, f"r{i}", n) for i, n in enumerate([10, 100, 300, 600, 5000])]
+    batches = bucket_by_length(recs, bucket_bounds=(256, 512, 1024), batch_size=4)
+    pads = sorted(b.pad_len for b in batches)
+    assert pads == [256, 512, 1024, 5120]
+    total = sum(b.batch_size for b in batches)
+    assert total == 5
