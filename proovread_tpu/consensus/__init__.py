@@ -1,0 +1,13 @@
+"""Consensus/correction engine — the algorithmic core.
+
+Tensor reformulation of the reference's ``lib/Sam/Seq.pm``: per-column counts
+over a fixed state alphabet [A,C,G,T,N,-] plus capped insertion-vote tensors,
+built by scatter-add over alignment column windows, reduced by (optionally
+phred-weighted) majority vote.
+"""
+
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.consensus.alnset import AlnSet, Alignment
+from proovread_tpu.consensus.engine import ConsensusEngine
+
+__all__ = ["ConsensusParams", "AlnSet", "Alignment", "ConsensusEngine"]

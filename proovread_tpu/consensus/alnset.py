@@ -1,0 +1,154 @@
+"""Alignment records and the per-bin admission policy.
+
+``Alignment`` is the minimal record the engine needs (the role of
+``lib/Sam/Alignment.pm``); ``AlnSet`` groups alignments of one long read and
+applies score filters + score-binned coverage-capped admission — the parallel
+reformulation of ``Sam::Seq::add_aln_by_score`` (``Sam/Seq.pm:582-614``):
+instead of arrival-order insert-with-eviction, alignments are ranked by
+ncscore per bin and admitted while the bin's base budget lasts. End states
+agree up to the reference's own documented sort-tie nondeterminism
+(``README.org:285-321``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from proovread_tpu.consensus.cigar import parse_cigar, ref_span
+from proovread_tpu.consensus.params import NCSCORE_CONSTANT, ConsensusParams
+
+
+@dataclass
+class Alignment:
+    """One short-read (or unitig) alignment onto a long read."""
+
+    qname: str
+    pos0: int                       # 0-based reference position
+    seq_codes: np.ndarray           # int8 query codes incl. soft-clipped bases
+    ops: np.ndarray                 # CIGAR op codes (cigar.M/I/D/S/H)
+    lens: np.ndarray                # CIGAR op lengths
+    qual: Optional[np.ndarray] = None  # uint8 phreds or None
+    score: Optional[float] = None   # AS tag
+    flag: int = 0
+    _span: Optional[int] = None
+
+    @classmethod
+    def from_cigar_str(cls, qname, pos0, seq_codes, cigar, **kw) -> "Alignment":
+        ops, lens = parse_cigar(cigar)
+        return cls(qname=qname, pos0=pos0, seq_codes=np.asarray(seq_codes, np.int8),
+                   ops=ops, lens=lens, **kw)
+
+    @property
+    def span(self) -> int:
+        """Reference span (M+D) — the 'length' used for bins, coverage and
+        nscore (Sam/Alignment.pm soft-clip branch :393-431)."""
+        if self._span is None:
+            self._span = ref_span(self.ops, self.lens)
+        return self._span
+
+    def effective_score(self, invert: bool) -> Optional[float]:
+        if self.score is None:
+            return None
+        return -self.score if invert else self.score
+
+    def nscore(self, invert: bool) -> Optional[float]:
+        s = self.effective_score(invert)
+        if s is None or self.span == 0:
+            return None
+        return s / self.span
+
+    def ncscore(self, invert: bool) -> Optional[float]:
+        ns = self.nscore(invert)
+        if ns is None:
+            return None
+        return ns * (self.span / (NCSCORE_CONSTANT + self.span))
+
+
+@dataclass
+class AlnSet:
+    """Alignments of one long read, plus admission bookkeeping."""
+
+    ref_id: str
+    ref_len: int
+    alns: List[Alignment] = field(default_factory=list)
+    params: ConsensusParams = field(default_factory=ConsensusParams)
+    # filled by admit():
+    bin_bases: Optional[np.ndarray] = None   # float per bin, admitted bases
+    aln_bins: Optional[np.ndarray] = None    # bin of each admitted aln
+
+    @property
+    def n_bins(self) -> int:
+        return self.ref_len // self.params.bin_size + 1
+
+    def bins_of(self, alns: Sequence[Alignment]) -> np.ndarray:
+        """bin = floor((pos_1based + span/2)/bin_size) (Sam/Seq.pm:1354-1357)."""
+        if not alns:
+            return np.zeros(0, np.int32)
+        pos1 = np.array([a.pos0 + 1 for a in alns], np.float64)
+        spans = np.array([a.span for a in alns], np.float64)
+        b = ((pos1 + spans / 2) / self.params.bin_size).astype(np.int32)
+        return np.clip(b, 0, self.n_bins - 1)
+
+    def filter_by_scores(self) -> None:
+        """min_score / min_nscore / min_ncscore cutoffs (Sam/Seq.pm:899-927).
+        Alignments with no score are dropped when a cutoff is set."""
+        p = self.params
+        inv = p.invert_scores
+
+        def keep(a: Alignment) -> bool:
+            if p.min_score is not None:
+                s = a.effective_score(inv)
+                if s is None or s < p.min_score:
+                    return False
+            if p.min_nscore is not None:
+                s = a.nscore(inv)
+                if s is None or s < p.min_nscore:
+                    return False
+            if p.min_ncscore is not None:
+                s = a.ncscore(inv)
+                if s is None or s < p.min_ncscore:
+                    return False
+            return True
+
+        self.alns = [a for a in self.alns if keep(a)]
+
+    def admit(self, cap_coverage: bool = True) -> None:
+        """Score-binned admission: per bin, rank by ncscore (desc) and admit
+        while the cumulative admitted bases *before* an alignment stay within
+        bin_max_bases (the reference admits the crossing alignment too:
+        Sam/Seq.pm:591). With ``cap_coverage`` False (utg mode's plain
+        add_aln), all alignments with a defined ncscore are kept."""
+        p = self.params
+        alns = [a for a in self.alns if a.ncscore(p.invert_scores) is not None]
+        if not alns:
+            self.alns = []
+            self.aln_bins = np.zeros(0, np.int32)
+            self.bin_bases = np.zeros(self.n_bins, np.float64)
+            return
+        bins = self.bins_of(alns)
+        spans = np.array([a.span for a in alns], np.float64)
+        if not cap_coverage:
+            self.alns = alns
+            self.aln_bins = bins
+            self.bin_bases = np.bincount(bins, weights=spans, minlength=self.n_bins)
+            return
+        scores = np.array([a.ncscore(p.invert_scores) for a in alns], np.float64)
+        # stable sort by (bin asc, score desc, original order asc)
+        order = np.lexsort((np.arange(len(alns)), -scores, bins))
+        sbins = bins[order]
+        sspans = spans[order]
+        # cumulative bases before each aln within its bin
+        cum = np.cumsum(sspans)
+        bin_start = np.searchsorted(sbins, sbins)  # first index of each aln's bin run
+        bases_before_bin = np.where(bin_start > 0, cum[bin_start - 1], 0.0)
+        cum_before = cum - sspans - bases_before_bin  # admitted bases ahead of me in my bin
+        admit = cum_before <= p.bin_max_bases
+        keep_idx = np.sort(order[admit])
+        self.alns = [alns[i] for i in keep_idx]
+        self.aln_bins = bins[keep_idx]
+        self.bin_bases = np.bincount(
+            self.aln_bins, weights=spans[keep_idx], minlength=self.n_bins
+        )

@@ -1,0 +1,385 @@
+"""Consensus engine orchestration: host packing -> device pileup + call ->
+host assembly, plus the chimera entropy detector.
+
+The per-worker flow mirrors ``bin/bam2cns:375-491`` (generate_consensus /
+detect_chimera): score filters, binned admission, state-matrix consensus with
+MCR ignore-coords, optional chimera scan with breakpoint projection through
+the consensus cigar (-I, +D: ``bin/bam2cns:461-491``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from proovread_tpu.consensus.alnset import AlnSet
+from proovread_tpu.consensus.cigar import ColumnStates, expand_alignment, freqs_to_phreds, phreds_to_freqs
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.batch import ReadBatch
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops import pileup as pileup_ops
+from proovread_tpu.ops.consensus_call import call_consensus
+from proovread_tpu.ops.encode import GAP, N_STATES, decode_codes
+
+import jax.numpy as jnp
+
+
+@dataclass
+class ConsensusResult:
+    record: SeqRecord                 # corrected read (id, seq, phred qual)
+    freqs: np.ndarray                 # winning vote weight per consensus base
+    coverage: np.ndarray              # total column coverage per ref column
+    cigar: str                        # consensus->reference cigar (M/I/D)
+    chimera: List[Tuple[int, int, float]] = field(default_factory=list)
+    # (from, to, score) in corrected-sequence coords
+
+    @property
+    def masked_frac(self) -> float:
+        """Fraction of bases at phred 0 (uncorrected)."""
+        if self.record.qual is None or len(self.record.qual) == 0:
+            return 0.0
+        return float((self.record.qual == 0).mean())
+
+
+def _round_up(n: int, m: int) -> int:
+    return max(m, ((n + m - 1) // m) * m)
+
+
+class ConsensusEngine:
+    """Batched consensus over groups of long reads.
+
+    ``cell_budget`` bounds the transient [chunk_rows x window] device
+    tensors; chunk row count adapts to the window width so unitig-scale
+    alignments don't blow memory.
+    """
+
+    def __init__(self, params: Optional[ConsensusParams] = None, cell_budget: int = 1 << 22):
+        self.params = params or ConsensusParams()
+        self.cell_budget = cell_budget
+
+    # -- packing ---------------------------------------------------------
+    def _expand_sets(
+        self, alnsets: Sequence[AlnSet]
+    ) -> List[List[Tuple[ColumnStates, int]]]:
+        """Per read: [(column states, index into aset.alns)] — the index keeps
+        bin bookkeeping aligned after taboo-trim drops."""
+        out = []
+        for aset in alnsets:
+            cols = []
+            for j, a in enumerate(aset.alns):
+                cs = expand_alignment(
+                    a.pos0, a.ops, a.lens, a.seq_codes, a.qual, self.params
+                )
+                if cs is not None:
+                    cols.append((cs, j))
+            out.append(cols)
+        return out
+
+    def _build_pileup(
+        self,
+        expanded: Sequence[Sequence[Tuple[ColumnStates, int]]],
+        L: int,
+        ignore_mask: Optional[np.ndarray] = None,
+        ref_codes: Optional[np.ndarray] = None,
+        ref_freqs: Optional[np.ndarray] = None,
+    ) -> pileup_ops.Pileup:
+        B = len(expanded)
+        K = self.params.ins_cap
+        pile = pileup_ops.init_pileup(B, L, K)
+
+        flat: List[Tuple[int, ColumnStates]] = [
+            (i, cs) for i, group in enumerate(expanded) for cs, _ in group
+        ]
+        if flat:
+            W = _round_up(max(cs.span for _, cs in flat), 128)
+            R = max(1, min(len(flat), self.cell_budget // W))
+            ign = jnp.asarray(ignore_mask) if ignore_mask is not None else None
+            for start in range(0, len(flat), R):
+                chunk = flat[start : start + R]
+                read_idx = np.zeros(R, np.int32)
+                rpos = np.zeros(R, np.int32)
+                state = np.full((R, W), -1, np.int8)
+                freq = np.zeros((R, W), np.float32)
+                ins_len = np.zeros((R, W), np.int16)
+                ins_bases = np.full((R, W, K), 0, np.int8)
+                valid = np.zeros(R, bool)
+                for j, (ri, cs) in enumerate(chunk):
+                    s = cs.span
+                    read_idx[j] = ri
+                    rpos[j] = cs.rpos
+                    state[j, :s] = cs.state
+                    freq[j, :s] = cs.freq
+                    ins_len[j, :s] = cs.ins_len
+                    ins_bases[j, :s] = cs.ins_bases
+                    valid[j] = True
+                pile = pileup_ops.accumulate(
+                    pile,
+                    jnp.asarray(read_idx),
+                    jnp.asarray(rpos),
+                    jnp.asarray(state),
+                    jnp.asarray(freq),
+                    jnp.asarray(ins_len),
+                    jnp.asarray(ins_bases),
+                    jnp.asarray(valid),
+                    ign,
+                )
+
+        if self.params.use_ref_qual and ref_codes is not None and ref_freqs is not None:
+            # reference read's own bases vote with phred->freq weight
+            # (Sam/Seq.pm:255-266); never through the insertion tensors
+            onehot = (
+                (ref_codes[:, :, None] == np.arange(N_STATES)[None, None, :])
+                .astype(np.float32)
+                * ref_freqs[:, :, None]
+            )
+            pile = pileup_ops.Pileup(
+                counts=pile.counts + jnp.asarray(onehot),
+                ins_mbase=pile.ins_mbase,
+                ins_len_votes=pile.ins_len_votes,
+                ins_base_votes=pile.ins_base_votes,
+            )
+        return pile
+
+    # -- main entry ------------------------------------------------------
+    def consensus_batch(
+        self,
+        refs: ReadBatch,
+        alnsets: Sequence[AlnSet],
+        ignore_coords: Optional[Sequence[Sequence[Tuple[int, int]]]] = None,
+        detect_chimera: bool = False,
+    ) -> List[ConsensusResult]:
+        """Correct a batch of long reads.
+
+        ``refs``: the long reads (packed); ``alnsets[i]``: alignments onto
+        read i (admission is applied here if not already done);
+        ``ignore_coords[i]``: [offset, length] regions whose columns take no
+        votes (MCRs from previous iterations, utg overlap windows).
+        """
+        B, L = refs.codes.shape
+        assert len(alnsets) == B
+
+        for aset in alnsets:
+            aset.filter_by_scores()
+            if aset.bin_bases is None:
+                aset.admit()
+
+        expanded = self._expand_sets(alnsets)
+
+        ignore_mask = None
+        if ignore_coords is not None:
+            ignore_mask = np.zeros((B, L), bool)
+            for i, regions in enumerate(ignore_coords):
+                for off, ln in regions or []:
+                    ignore_mask[i, max(0, off) : off + ln] = True
+
+        ref_freqs = None
+        if self.params.use_ref_qual:
+            ref_freqs = phreds_to_freqs(refs.qual.astype(np.float32)).astype(np.float32)
+            ref_freqs *= refs.position_mask()
+
+        pile = self._build_pileup(
+            expanded, L, ignore_mask=ignore_mask,
+            ref_codes=refs.codes, ref_freqs=ref_freqs,
+        )
+        call = call_consensus(pile, jnp.asarray(refs.codes), self.params.max_ins_length)
+
+        # host assembly
+        emitted = np.asarray(call.emitted)
+        base = np.asarray(call.base)
+        ins_len = np.asarray(call.ins_len)
+        ins_bases = np.asarray(call.ins_bases)
+        freq = np.asarray(call.freq)
+        phred = np.asarray(call.phred)
+        coverage = np.asarray(call.coverage)
+
+        results = []
+        for i in range(B):
+            n = int(refs.lengths[i])
+            res = self._assemble(
+                refs.ids[i],
+                emitted[i, :n],
+                base[i, :n],
+                ins_len[i, :n],
+                ins_bases[i, :n],
+                freq[i, :n],
+                phred[i, :n],
+                coverage[i, :n],
+            )
+            if detect_chimera:
+                res.chimera = self._chimera(
+                    alnsets[i], expanded[i], int(refs.lengths[i]), res
+                )
+            results.append(res)
+        return results
+
+    def _assemble(
+        self, rid, emitted, base, ins_len, ins_bases, freq, phred, coverage
+    ) -> ConsensusResult:
+        n = len(emitted)
+        emit_counts = np.where(emitted, 1 + ins_len, 0)
+        total = int(emit_counts.sum())
+        seq = np.zeros(total, np.int8)
+        quals = np.zeros(total, np.uint8)
+        freqs = np.zeros(total, np.float32)
+        # target offset of each column's first emitted base
+        offs = np.concatenate([[0], np.cumsum(emit_counts)[:-1]])
+        em = emitted.astype(bool)
+        seq[offs[em]] = base[em]
+        quals[offs[em]] = phred[em]
+        freqs[offs[em]] = freq[em]
+        ins_cols = np.flatnonzero(em & (ins_len > 0))
+        for c in ins_cols:
+            k = int(ins_len[c])
+            o = int(offs[c]) + 1
+            seq[o : o + k] = ins_bases[c, :k]
+            quals[o : o + k] = phred[c]
+            freqs[o : o + k] = freq[c]
+
+        # consensus cigar: M per emitted column (+D per extra base), I per
+        # dropped column — Sam::Seq trace semantics (Sam/Seq.pm:1625-1635)
+        cigar_parts = []
+        run_char, run_len = None, 0
+        for c in range(n):
+            chars = "I" if not em[c] else ("M" + "D" * int(ins_len[c]))
+            for ch in chars:
+                if ch == run_char:
+                    run_len += 1
+                else:
+                    if run_char is not None:
+                        cigar_parts.append(f"{run_len}{run_char}")
+                    run_char, run_len = ch, 1
+        if run_char is not None:
+            cigar_parts.append(f"{run_len}{run_char}")
+
+        rec = SeqRecord(id=rid, seq=decode_codes(seq), qual=quals)
+        return ConsensusResult(
+            record=rec,
+            freqs=freqs,
+            coverage=coverage,
+            cigar="".join(cigar_parts),
+        )
+
+    # -- chimera (Sam/Seq.pm:774-888 + bam2cns:461-491) ------------------
+    def _chimera(
+        self,
+        aset: AlnSet,
+        expanded: Sequence[Tuple[ColumnStates, int]],
+        L: int,
+        res: ConsensusResult,
+    ) -> List[Tuple[int, int, float]]:
+        p = self.params
+        bin_bases = aset.bin_bases
+        if bin_bases is None or len(bin_bases) <= 20:
+            return []
+        thr = p.bin_max_bases / 5 + 1
+
+        # runs of 1-4 consecutive low-coverage bins, skipping 5 terminal bins
+        runs = []
+        lcov = 0
+        for i in range(5, len(bin_bases) - 5):
+            if bin_bases[i] <= thr:
+                lcov += 1
+            elif lcov:
+                if 1 <= lcov < 5:
+                    runs.append((i - lcov, i - 1))
+                lcov = 0
+        if not runs:
+            return []
+
+        # plain full coverage for the covered-window check (chimera recomputes
+        # its own matrix without ignore coords / weighting, bam2cns:461)
+        cover = np.zeros(L)
+        for cs, _ in expanded:
+            a, b = max(0, cs.rpos), min(L, cs.rpos + cs.span)
+            cover[a:b] += 1
+
+        # project ref coords -> corrected coords: corrected = #bases emitted
+        # before the column (equivalent to the reference's -I,+D cigar walk)
+        emit_counts_prefix = None
+
+        out = []
+        bs = p.bin_size
+        aln_bins = aset.aln_bins
+        for (r0, r1) in runs:
+            mat_from = (r0 - 1) * bs
+            mat_to = (r1 + 2) * bs - 1
+            if mat_from < 0 or mat_to >= L:
+                continue
+            if np.any(cover[mat_from : mat_to + 1] == 0):
+                continue
+            fl, tr = r0 - 4, r1 + 5
+            delta = (tr - fl - 1) // 2
+            tl, fr = fl + delta, tr - delta
+
+            sel_l = [cs for cs, j in expanded if fl <= aln_bins[j] <= tl]
+            sel_r = [cs for cs, j in expanded if fr <= aln_bins[j] <= tr]
+            Wn = mat_to + 1 - mat_from
+            cl = self._window_counts(sel_l, mat_from, Wn)
+            cr = self._window_counts(sel_r, mat_from, Wn)
+
+            hx_delta = []
+            for c in range(Wn):
+                l, r = cl[c], cr[c]
+                if l.sum() == 0 or r.sum() == 0:
+                    continue
+                comb = l + r
+                hx_delta.append(_hx(comb) - max(_hx(l), _hx(r)))
+            if not hx_delta:
+                continue
+            score = float(np.mean(np.array(hx_delta) > 0.7))
+            f, t = mat_from + bs, mat_to - bs
+            if emit_counts_prefix is None:
+                emit_counts_prefix = self._emit_prefix(res, L)
+            out.append((int(emit_counts_prefix[f]), int(emit_counts_prefix[t]), score))
+        return out
+
+    def _window_counts(self, sel: Sequence[ColumnStates], mat_from: int, Wn: int) -> np.ndarray:
+        """[Wn, S+1] plain state counts + merged-insertion pseudo-state."""
+        counts = np.zeros((Wn, N_STATES + 1), np.float64)
+        for cs in sel:
+            lo = max(cs.rpos, mat_from)
+            hi = min(cs.rpos + cs.span, mat_from + Wn)
+            if lo >= hi:
+                continue
+            w0, w1 = lo - cs.rpos, hi - cs.rpos
+            cols = np.arange(lo - mat_from, hi - mat_from)
+            st = cs.state[w0:w1].astype(np.int64)
+            has_ins = cs.ins_len[w0:w1] > 0
+            np.add.at(counts, (cols[~has_ins], st[~has_ins]), 1.0)
+            np.add.at(counts, (cols[has_ins], np.full(has_ins.sum(), N_STATES)), 1.0)
+        return counts
+
+    def _emit_prefix(self, res: ConsensusResult, L: int) -> np.ndarray:
+        """corrected-coordinate of each reference column (prefix sum of
+        emitted base counts), recovered from the consensus cigar."""
+        emit = np.zeros(L + 1, np.int64)
+        col = 0
+        import re as _re
+
+        pos_corr = 0
+        for m in _re.finditer(r"(\d+)([MID])", res.cigar):
+            ln, op = int(m.group(1)), m.group(2)
+            if op == "M":
+                for _ in range(ln):
+                    emit[col] = pos_corr
+                    pos_corr += 1
+                    col += 1
+            elif op == "I":
+                for _ in range(ln):
+                    emit[col] = pos_corr
+                    col += 1
+            else:  # D: extra consensus bases, no ref column consumed
+                pos_corr += ln
+        emit[col:] = pos_corr
+        return emit
+
+
+def _hx(col: np.ndarray) -> float:
+    """Shannon entropy over nonzero counts (Sam/Seq.pm:188-197)."""
+    nz = col[col > 0]
+    if nz.size == 0:
+        return 0.0
+    p = nz / nz.sum()
+    return float(-(p * np.log2(p)).sum())

@@ -178,40 +178,51 @@ def _sample_seekable(reader, n: int, seed: int) -> List[SeqRecord]:
         if len(recs) <= n:
             return recs
         return rng.sample(recs, n)
+    pos, pending = fh.tell(), reader._pending
     out: List[SeqRecord] = []
     seen_ids = set()
     attempts = 0
-    while len(out) < n and attempts < n * 20:
-        attempts += 1
-        reader.seek(rng.randrange(size))
-        try:
-            rec = next(reader)
-        except StopIteration:
-            continue
-        if rec.id not in seen_ids:
-            seen_ids.add(rec.id)
-            out.append(rec)
+    try:
+        while len(out) < n and attempts < n * 20:
+            attempts += 1
+            reader.seek(rng.randrange(size))
+            try:
+                rec = next(reader)
+            except StopIteration:
+                continue
+            if rec.id not in seen_ids:
+                seen_ids.add(rec.id)
+                out.append(rec)
+    finally:
+        fh.seek(pos)
+        reader._pending = pending
     return out
+
+
+def _count_all(reader) -> int:
+    """Record count by full iteration from the start, restoring the stream."""
+    fh = reader._fh
+    pos = None
+    pending = reader._pending
+    try:
+        pos = fh.tell()
+        fh.seek(0)
+    except (OSError, io.UnsupportedOperation):
+        pass
+    reader._pending = None
+    count = sum(1 for _ in reader)
+    if pos is not None:
+        fh.seek(pos)
+    reader._pending = pending
+    return count
 
 
 def _estimate_count(reader, marker: bytes, probe_bytes: int) -> int:
     fh = reader._fh
     size = _stream_size(fh)
     if size is None:
-        # gzip / in-memory: count by full iteration from the start
-        pos = None
-        pending = reader._pending
-        try:
-            pos = fh.tell()
-            fh.seek(0)
-        except (OSError, io.UnsupportedOperation):
-            pass
-        reader._pending = None
-        count = sum(1 for _ in reader)
-        if pos is not None:
-            fh.seek(pos)
-        reader._pending = pending
-        return count
+        # gzip / in-memory: no byte-size heuristics possible
+        return _count_all(reader)
     pos = fh.tell()
     fh.seek(0)
     chunk = fh.read(min(probe_bytes, size))

@@ -113,6 +113,8 @@ class FastqReader:
         try:
             for _ in range(probe):
                 header = self._fh.readline()
+                while header in (b"\n", b"\r\n"):  # blank lines, as in __next__
+                    header = self._fh.readline()
                 if not header:
                     break
                 self._fh.readline()
@@ -140,11 +142,11 @@ class FastqReader:
 
     def estimate_count(self, probe_bytes: int = 1 << 20) -> int:
         """Record-count estimate from mean sampled record byte size."""
-        from proovread_tpu.io.fasta import _stream_size
+        from proovread_tpu.io.fasta import _count_all, _stream_size
 
         size = _stream_size(self._fh)
         if size is None:
-            return sum(1 for _ in self)
+            return _count_all(self)
         recs = self.sample(200)
         if not recs:
             return 0
@@ -178,7 +180,10 @@ class FastqWriter:
 
     def write(self, rec: SeqRecord) -> int:
         off = self._fh.tell() if self._fh.seekable() else -1
-        qual = rec.qual_str(self.phred_offset) if rec.qual is not None else "I" * len(rec.seq)
+        if rec.qual is not None:
+            qual = rec.qual_str(self.phred_offset)
+        else:
+            qual = chr(40 + self.phred_offset) * len(rec.seq)  # phred 40 in this offset
         self._fh.write(
             f"@{rec.full_id}\n{rec.seq}\n+\n{qual}\n".encode("ascii")
         )

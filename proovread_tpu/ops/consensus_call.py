@@ -1,0 +1,103 @@
+"""Device consensus call: per-column (optionally weighted) majority vote over
+the pileup tensors.
+
+Reproduces ``Sam::Seq::state_matrix_consensus`` (``Sam/Seq.pm:1568-1654``)
+with the plain/insertion state split of pileup.py: a column's candidates are
+the six plain states (votes of reads *without* an insertion after the column)
+plus one insertion pseudo-state (total weight of inserting reads). For the
+single-insertion-allele case this is exactly the reference's dynamic string
+states; multiple concurrent insertion alleles are merged (hierarchical vote)
+instead of splitting the vote — a deliberate, documented deviation that is
+at least as accurate and keeps the state space dense.
+
+Emitted per column: whether a base is emitted (gap-majority columns are
+dropped — trace 'I'), the base, up to K inserted bases following it, the
+winning vote weight (freq -> phred via sqrt(freq*120) capped at 40,
+``Sam/Seq.pm:136-142``), and total coverage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from proovread_tpu.consensus.params import MAX_PHRED, PROOVREAD_CONSTANT
+from proovread_tpu.ops.encode import GAP, N_STATES
+from proovread_tpu.ops.pileup import Pileup
+
+
+class ConsensusCall(NamedTuple):
+    emitted: jnp.ndarray       # bool [B, L] column emits a base
+    base: jnp.ndarray          # i8   [B, L] emitted base code (ref base when uncovered)
+    ins_len: jnp.ndarray       # i32  [B, L] inserted bases emitted after column
+    ins_bases: jnp.ndarray     # i8   [B, L, K] the inserted base codes
+    freq: jnp.ndarray          # f32  [B, L] winning vote weight (0 when uncovered)
+    phred: jnp.ndarray         # i32  [B, L] phred of emitted base
+    coverage: jnp.ndarray      # f32  [B, L] total column coverage
+
+    def emit_counts(self) -> jnp.ndarray:
+        """i32 [B, L]: consensus bases produced per reference column."""
+        return jnp.where(self.emitted, 1 + self.ins_len, 0)
+
+
+def freqs_to_phreds(freq: jnp.ndarray) -> jnp.ndarray:
+    p = jnp.floor(jnp.sqrt(jnp.maximum(freq, 0.0) * PROOVREAD_CONSTANT) + 0.5)
+    return jnp.minimum(p, MAX_PHRED).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("max_ins_length",))
+def call_consensus(
+    pile: Pileup,
+    ref_codes: jnp.ndarray,     # i8 [B, L] long-read base codes (pad N)
+    max_ins_length: int = 0,
+) -> ConsensusCall:
+    counts, ins_mbase = pile.counts, pile.ins_mbase
+    B, L, S = counts.shape
+    K = pile.ins_len_votes.shape[-1]
+
+    plain = counts - ins_mbase                      # reads without insertion
+    ins_w = ins_mbase.sum(-1)                       # inserting reads' weight
+
+    # majority insertion length (bucket k = len k+1) among inserting reads
+    maj_len = jnp.where(ins_w > 0, jnp.argmax(pile.ins_len_votes, axis=-1) + 1, 0)
+    ins_allowed = ins_w > 0
+    if max_ins_length:
+        # reference skips over-long insertion states in the vote
+        # (Sam/Seq.pm:1601-1607); state string length = 1 M char + ins
+        ins_allowed &= (1 + maj_len) <= max_ins_length
+    ins_cand = jnp.where(ins_allowed, ins_w, 0.0)
+
+    cand = jnp.concatenate([plain, ins_cand[:, :, None]], axis=-1)  # [B, L, S+1]
+    winner = jnp.argmax(cand, axis=-1)
+    max_freq = jnp.take_along_axis(cand, winner[:, :, None], axis=-1)[:, :, 0]
+
+    covered = max_freq > 0.0
+    is_ins = covered & (winner == S)
+    is_gap = covered & (winner == GAP)
+
+    # emitted base: plain winner / majority M-base of inserting reads /
+    # ref base when uncovered
+    ins_base = jnp.argmax(ins_mbase, axis=-1)
+    base = jnp.where(is_ins, ins_base, winner).astype(jnp.int8)
+    base = jnp.where(covered, base, ref_codes)
+
+    emitted = ~covered | ~is_gap   # uncovered columns emit the ref base
+
+    emit_ins = jnp.where(is_ins, jnp.minimum(maj_len, K), 0).astype(jnp.int32)
+    ins_bases = jnp.argmax(pile.ins_base_votes, axis=-1).astype(jnp.int8)  # [B, L, K]
+
+    freq = jnp.where(covered, jnp.where(is_ins, ins_w, max_freq), 0.0)
+    phred = freqs_to_phreds(freq)
+
+    return ConsensusCall(
+        emitted=emitted,
+        base=base,
+        ins_len=emit_ins,
+        ins_bases=ins_bases,
+        freq=freq,
+        phred=phred,
+        coverage=pile.coverage,
+    )

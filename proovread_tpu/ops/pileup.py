@@ -1,0 +1,120 @@
+"""Device pileup: scatter-add alignment column windows into per-read count
+tensors.
+
+The reference's per-column Perl hash increments (``Sam/Seq.pm:436-462``)
+become one flat scatter-add over [B*L*S]; insertion voting uses three side
+tensors (inserting-read weight per base, insertion-length votes, per-offset
+inserted-base votes) instead of dynamic string states — see
+consensus_call.py for how the vote is resolved.
+
+All functions are jit-compiled with static shapes; callers chunk alignments
+to a fixed R_c and pad.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from proovread_tpu.ops.encode import N_STATES
+
+
+class Pileup(NamedTuple):
+    """Accumulated vote tensors for a batch of B long reads of padded len L.
+
+    counts:        f32 [B, L, S]    per-state vote weight (every alignment
+                                    contributes exactly one state per column)
+    ins_mbase:     f32 [B, L, S]    per-state weight of reads that carry an
+                                    insertion after the column
+    ins_len_votes: f32 [B, L, K]    insertion length votes (bucket k =
+                                    length k+1; longer capped into K)
+    ins_base_votes:f32 [B, L, K, 5] inserted base votes per offset
+    """
+
+    counts: jnp.ndarray
+    ins_mbase: jnp.ndarray
+    ins_len_votes: jnp.ndarray
+    ins_base_votes: jnp.ndarray
+
+    @property
+    def coverage(self) -> jnp.ndarray:
+        return self.counts.sum(-1)
+
+
+def init_pileup(batch: int, length: int, ins_cap: int = 6) -> Pileup:
+    return Pileup(
+        counts=jnp.zeros((batch, length, N_STATES), jnp.float32),
+        ins_mbase=jnp.zeros((batch, length, N_STATES), jnp.float32),
+        ins_len_votes=jnp.zeros((batch, length, ins_cap), jnp.float32),
+        ins_base_votes=jnp.zeros((batch, length, ins_cap, 5), jnp.float32),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def accumulate(
+    pile: Pileup,
+    read_idx: jnp.ndarray,   # i32 [R]    target long read per alignment
+    rpos: jnp.ndarray,       # i32 [R]    0-based ref start of the window
+    state: jnp.ndarray,      # i8  [R, W] column state codes, -1 pad
+    freq: jnp.ndarray,       # f32 [R, W] vote weight
+    ins_len: jnp.ndarray,    # i16 [R, W] inserted bases after column (0=none)
+    ins_bases: jnp.ndarray,  # i8  [R, W, K] inserted base codes
+    valid: jnp.ndarray,      # bool [R]
+    ignore_mask: Optional[jnp.ndarray] = None,  # bool [B, L] True = skip col
+) -> Pileup:
+    """Add one chunk of R alignment windows to the pileup."""
+    B, L, S = pile.counts.shape
+    K = pile.ins_len_votes.shape[-1]
+    R, W = state.shape
+
+    cols = rpos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]      # [R, W]
+    ok = valid[:, None] & (state >= 0) & (cols >= 0) & (cols < L)
+    flat = read_idx[:, None] * L + jnp.clip(cols, 0, L - 1)             # [R, W]
+    if ignore_mask is not None:
+        ok &= ~ignore_mask.reshape(-1)[flat]
+    w = jnp.where(ok, freq, 0.0)
+
+    st = jnp.clip(state.astype(jnp.int32), 0, S - 1)
+    OOB = B * L * S  # dropped by mode='drop'
+    cidx = jnp.where(ok, flat * S + st, OOB)
+    counts = (
+        pile.counts.reshape(-1).at[cidx.reshape(-1)]
+        .add(w.reshape(-1), mode="drop")
+        .reshape(B, L, S)
+    )
+
+    has_ins = ok & (ins_len > 0)
+    midx = jnp.where(has_ins, flat * S + st, OOB)
+    ins_mbase = (
+        pile.ins_mbase.reshape(-1).at[midx.reshape(-1)]
+        .add(w.reshape(-1), mode="drop")
+        .reshape(B, L, S)
+    )
+
+    lbucket = jnp.clip(ins_len.astype(jnp.int32) - 1, 0, K - 1)
+    lidx = jnp.where(has_ins, flat * K + lbucket, B * L * K)
+    ins_len_votes = (
+        pile.ins_len_votes.reshape(-1).at[lidx.reshape(-1)]
+        .add(w.reshape(-1), mode="drop")
+        .reshape(B, L, K)
+    )
+
+    # per-offset base votes: only offsets < stored ins length vote
+    k_arange = jnp.arange(K, dtype=jnp.int32)
+    ins_ok = has_ins[:, :, None] & (k_arange[None, None, :] < ins_len[:, :, None])
+    ib = jnp.clip(ins_bases.astype(jnp.int32), 0, 4)
+    bidx = jnp.where(
+        ins_ok,
+        (flat[:, :, None] * K + k_arange[None, None, :]) * 5 + ib,
+        B * L * K * 5,
+    )
+    ins_base_votes = (
+        pile.ins_base_votes.reshape(-1).at[bidx.reshape(-1)]
+        .add(jnp.broadcast_to(w[:, :, None], bidx.shape).reshape(-1), mode="drop")
+        .reshape(B, L, K, 5)
+    )
+
+    return Pileup(counts, ins_mbase, ins_len_votes, ins_base_votes)

@@ -1,0 +1,367 @@
+"""M1 consensus-engine tests: CIGAR normalization, binned admission, device
+majority vote, end-to-end synthetic correction, chimera detection."""
+
+import random
+
+import numpy as np
+import pytest
+
+from proovread_tpu.consensus import Alignment, AlnSet, ConsensusEngine, ConsensusParams
+from proovread_tpu.consensus.cigar import (
+    ColumnStates,
+    expand_alignment,
+    freqs_to_phreds,
+    parse_cigar,
+    phreds_to_freqs,
+    ref_span,
+)
+from proovread_tpu.io.batch import pack_reads
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops.encode import GAP, decode_codes, encode_ascii
+
+NOTRIM = ConsensusParams(trim=False, min_aln_length=3)
+
+
+def aln(pos, seq, cigar, qual=None, score=None, qname="q"):
+    return Alignment.from_cigar_str(
+        qname, pos, encode_ascii(seq), cigar,
+        qual=None if qual is None else np.asarray(qual, np.uint8),
+        score=score,
+    )
+
+
+# -- cigar machinery ---------------------------------------------------------
+
+def test_parse_cigar():
+    ops, lens = parse_cigar("10M2D3M1I4M")
+    assert lens.tolist() == [10, 2, 3, 1, 4]
+    assert ref_span(ops, lens) == 10 + 2 + 3 + 4
+    assert parse_cigar("*")[0].size == 0
+    with pytest.raises(ValueError):
+        parse_cigar("10M3Z")
+    with pytest.raises(ValueError):
+        parse_cigar("M10")
+
+
+def test_expand_simple_match():
+    cs = expand_alignment(5, *parse_cigar("8M"), encode_ascii("ACGTACGT"), None, NOTRIM)
+    assert cs.rpos == 5 and cs.span == 8
+    assert decode_codes(cs.state) == "ACGTACGT"
+    assert np.all(cs.freq == 1.0)
+    assert np.all(cs.ins_len == 0)
+
+
+def test_expand_soft_clip():
+    cs = expand_alignment(10, *parse_cigar("2S5M3S"), encode_ascii("TTACGTACCC"), None, NOTRIM)
+    assert cs.rpos == 10 and cs.span == 5
+    assert decode_codes(cs.state) == "ACGTA"
+
+
+def test_expand_deletion_and_insertion():
+    # 3M 2D 2M 2I 3M over ref span 10
+    cs = expand_alignment(0, *parse_cigar("3M2D2M2I3M"), encode_ascii("ACGTTGGAAA"), None, NOTRIM)
+    assert cs.span == 10
+    assert decode_codes(cs.state) == "ACG--TTAAA"
+    assert cs.ins_len[4] == 0 and cs.ins_len[5] == 0
+    # insertion attaches to the column before it (index 4 in window = 2nd M)
+    assert cs.ins_len.tolist() == [0, 0, 0, 0, 0, 0, 2, 0, 0, 0]
+    assert decode_codes(cs.ins_bases[6, :2]) == "GG"
+
+
+def test_expand_bowtie2_1d1i_quirk():
+    # 1D1I becomes a mismatch column (Sam/Seq.pm:413-419)
+    cs = expand_alignment(0, *parse_cigar("3M1D1I3M"), encode_ascii("ACGTACG"), None, NOTRIM)
+    assert cs.span == 7
+    assert decode_codes(cs.state) == "ACGTACG"
+    assert np.all(cs.ins_len == 0)
+
+
+def test_expand_qual_weighted():
+    p = ConsensusParams(trim=False, min_aln_length=3, qual_weighted=True)
+    qual = np.array([40, 40, 10, 40, 40], np.uint8)
+    cs = expand_alignment(0, *parse_cigar("2M1D3M"), encode_ascii("ACGTA"), qual, p)
+    # M columns: freq = round2(q^2/120)
+    assert cs.freq[0] == pytest.approx(13.33)
+    assert cs.freq[2] == pytest.approx(phreds_to_freqs(np.array([10.0]))[0])  # D col: min(q_prev,q_next)=10
+    assert cs.freq[3] == pytest.approx(0.83)  # the q10 M char
+
+
+def test_expand_short_aln_dropped():
+    p = ConsensusParams(trim=False, min_aln_length=50)
+    assert expand_alignment(0, *parse_cigar("30M"), encode_ascii("A" * 30), None, p) is None
+
+
+def test_taboo_trim_head():
+    # 100bp read, taboo_len = 10; leading 4M1I95M: head M-run 4 < 10 so the
+    # first M run crossing taboo is the 95M -> cut the 4M1I before it
+    p = ConsensusParams(min_aln_length=50)
+    seq = "A" * 100
+    cs = expand_alignment(50, *parse_cigar("4M1I95M"), encode_ascii(seq), None, p)
+    assert cs is not None
+    assert cs.rpos == 54  # 4 match cols consumed before cut
+    assert cs.span == 95
+    assert np.all(cs.ins_len == 0)
+
+
+def test_taboo_trim_tail():
+    p = ConsensusParams(min_aln_length=50)
+    # tail pass: 5M(tail=5) <- 1D(skip) <- 10M(tail=15 > taboo 10, not last op)
+    # -> cut the trailing 1D5M, keeping 80M1I10M (span 90)
+    seq = "A" * 96  # 80+1+10+5 query bases
+    cs = expand_alignment(0, *parse_cigar("80M1I10M1D5M"), encode_ascii(seq), None, p)
+    assert cs is not None
+    assert cs.span == 90
+    # a crossing M-run that is the LAST op never cuts (reference loop bound)
+    cs2 = expand_alignment(0, *parse_cigar("95M1D4M"), encode_ascii("A" * 99), None, p)
+    assert cs2.span == 100
+
+
+def test_taboo_keep_rule():
+    # a head cut that leaves <50 bp drops the alignment (Sam/Seq.pm:352-354)
+    p = ConsensusParams(min_aln_length=50)
+    assert expand_alignment(0, *parse_cigar("5M1I49M"), encode_ascii("A" * 55), None, p) is None
+    # a first M-run crossing the taboo boundary never cuts (i==0 branch)
+    cs = expand_alignment(0, *parse_cigar("40M1I59M"), encode_ascii("A" * 100), None, p)
+    assert cs is not None and cs.span == 99
+
+
+def test_phred_freq_roundtrip():
+    assert freqs_to_phreds(np.array([0.0]))[0] == 0
+    assert freqs_to_phreds(np.array([1.0]))[0] == 11  # sqrt(120)=10.95 -> 11
+    assert freqs_to_phreds(np.array([50.0]))[0] == 40  # capped
+    assert phreds_to_freqs(np.array([40.0]))[0] == pytest.approx(13.33)
+
+
+# -- admission ---------------------------------------------------------------
+
+def test_admission_caps_bin_bases():
+    p = ConsensusParams(bin_size=20, max_coverage=2)  # budget 40 bases/bin
+    aset = AlnSet("r", 100, params=p)
+    # five 30bp alns centered in bin 2, scores descending
+    for i in range(5):
+        aset.alns.append(aln(20, "A" * 30, "30M", score=50 - i, qname=f"q{i}"))
+    aset.admit()
+    # rank by score: cum_before 0,30,60 -> first two admitted, third crosses
+    # (cum_before 60 > 40) -> rejected
+    assert len(aset.alns) == 2
+    assert [a.qname for a in aset.alns] == ["q0", "q1"]
+
+
+def test_admission_crossing_aln_kept():
+    p = ConsensusParams(bin_size=20, max_coverage=2)
+    aset = AlnSet("r", 100, params=p)
+    for i in range(3):
+        aset.alns.append(aln(20, "A" * 35, "35M", score=50 - i, qname=f"q{i}"))
+    aset.admit()
+    # cum_before: 0, 35, 70 -> q0, q1 admitted (35 <= 40), q2 rejected
+    assert [a.qname for a in aset.alns] == ["q0", "q1"]
+
+
+def test_admission_prefers_score_over_arrival():
+    p = ConsensusParams(bin_size=20, max_coverage=1)  # 20 bases budget
+    aset = AlnSet("r", 100, params=p)
+    aset.alns.append(aln(20, "A" * 30, "30M", score=10, qname="low"))
+    aset.alns.append(aln(20, "A" * 30, "30M", score=90, qname="high"))
+    aset.admit()
+    assert [a.qname for a in aset.alns] == ["high"]
+
+
+def test_admission_unscored_dropped():
+    aset = AlnSet("r", 100)
+    aset.alns.append(aln(0, "A" * 60, "60M", score=None))
+    aset.admit()
+    assert len(aset.alns) == 0
+
+
+def test_score_filters():
+    p = ConsensusParams(min_ncscore=1.0)
+    aset = AlnSet("r", 200, params=p)
+    # ncscore = (score/span) * span/(40+span); span 100 -> score/140
+    aset.alns.append(aln(0, "A" * 100, "100M", score=200, qname="good"))   # 1.43
+    aset.alns.append(aln(0, "A" * 100, "100M", score=100, qname="bad"))    # 0.71
+    aset.filter_by_scores()
+    assert [a.qname for a in aset.alns] == ["good"]
+
+
+def test_invert_scores():
+    p = ConsensusParams(min_ncscore=1.0, invert_scores=True)
+    aset = AlnSet("r", 200, params=p)
+    aset.alns.append(aln(0, "A" * 100, "100M", score=-200, qname="blasr"))
+    aset.filter_by_scores()
+    assert len(aset.alns) == 1
+
+
+# -- engine end-to-end -------------------------------------------------------
+
+def _tile_reads(truth, read_len=60, step=7):
+    """Perfect short reads tiled over a sequence."""
+    out = []
+    for s in range(0, len(truth) - read_len + 1, step):
+        out.append((s, truth[s : s + read_len]))
+    return out
+
+
+def test_engine_corrects_substitutions():
+    rng = random.Random(7)
+    truth = "".join(rng.choice("ACGT") for _ in range(600))
+    # long read: truth with 30 substitutions
+    lr = list(truth)
+    sub_pos = rng.sample(range(10, 590), 30)
+    for sp in sub_pos:
+        lr[sp] = rng.choice([c for c in "ACGT" if c != lr[sp]])
+    lr = "".join(lr)
+
+    engine = ConsensusEngine(ConsensusParams(trim=False))
+    aset = AlnSet("lr1", len(lr), params=engine.params)
+    for s, rs in _tile_reads(truth):
+        # reads are truth windows; vs the long read they are all-M with mismatches
+        aset.alns.append(aln(s, rs, f"{len(rs)}M", score=5 * len(rs), qname=f"s{s}"))
+    refs = pack_reads([SeqRecord("lr1", lr)])
+    res = engine.consensus_batch(refs, [aset])[0]
+    assert res.record.seq == truth
+    assert res.record.qual[5:-5].min() > 0
+
+
+def test_engine_corrects_indels():
+    rng = random.Random(8)
+    truth = "".join(rng.choice("ACGT") for _ in range(400))
+    # long read: truth missing base at 150 (deletion) + extra base at 250 (insertion)
+    del_pos, ins_pos = 150, 250
+    lr = truth[:del_pos] + truth[del_pos + 1 :]
+    lr = lr[: ins_pos] + "A" + lr[ins_pos:]  # note: coords in lr space now
+
+    engine = ConsensusEngine(ConsensusParams(trim=False))
+    aset = AlnSet("lr1", len(lr), params=engine.params)
+    for s, rs in _tile_reads(truth, read_len=80, step=9):
+        # build cigar of truth-window vs long read
+        # truth coord t maps to lr coord: t if t < del_pos else t-1; then +1 after ins_pos
+        ops = []
+        lr_start = None
+        t = s
+        # walk truth window char by char, tracking lr coordinate
+        def t2lr(t):
+            x = t if t < del_pos else t - 1
+            return x if x < ins_pos else x + 1
+        # emit cigar segments
+        end = s + len(rs)
+        covers_del = s < del_pos < end
+        covers_ins_site = s <= ins_pos - 1 and end > ins_pos  # lr extra base inside window span
+        lr_start = t2lr(s)
+        if not covers_del and not covers_ins_site:
+            cigar = f"{len(rs)}M"
+        else:
+            # piecewise: M runs broken by I (missing base in lr) at del_pos and
+            # D (extra lr base) after ins boundary
+            parts = []
+            cur = s
+            events = []
+            if covers_del:
+                events.append((del_pos, "I"))
+            # extra base sits between truth coords; find truth coord whose lr
+            # position jumps by 2: lr coord ins_pos is the inserted 'A'
+            if covers_ins_site:
+                # truth coordinate t* where t2lr(t*) - t2lr(t*-1) == 2
+                for t_ in range(s + 1, end):
+                    if t2lr(t_) - t2lr(t_ - 1) == 2:
+                        events.append((t_, "D"))
+                        break
+            events.sort()
+            for epos, kind in events:
+                if kind == "I":
+                    parts.append((epos - cur, "M"))
+                    parts.append((1, "I"))
+                    cur = epos + 1
+                else:
+                    parts.append((epos - cur, "M"))
+                    parts.append((1, "D"))
+                    cur = epos
+            parts.append((end - cur, "M"))
+            cigar = "".join(f"{n}{o}" for n, o in parts if n > 0)
+        aset.alns.append(aln(lr_start, rs, cigar, score=5 * len(rs), qname=f"s{s}"))
+
+    refs = pack_reads([SeqRecord("lr1", lr)])
+    res = engine.consensus_batch(refs, [aset])[0]
+    assert res.record.seq == truth
+    assert "I" in res.cigar and "D" in res.cigar
+
+
+def test_engine_ignore_coords():
+    truth = "ACGT" * 50
+    lr = truth
+    engine = ConsensusEngine(ConsensusParams(trim=False))
+    aset = AlnSet("lr1", len(lr), params=engine.params)
+    # reads voting T at every position, but first 100 cols are ignored
+    bad = "T" * 60
+    for s in range(0, 140, 10):
+        aset.alns.append(aln(s, bad, "60M", score=300, qname=f"s{s}"))
+    refs = pack_reads([SeqRecord("lr1", lr)])
+    res = engine.consensus_batch(refs, [aset], ignore_coords=[[(0, 100)]])[0]
+    # ignored columns keep ref bases at phred 0; later columns voted T
+    assert res.record.seq[:100] == truth[:100]
+    assert np.all(res.record.qual[:100] == 0)
+    assert set(res.record.seq[100:140]) <= {"T", *truth[100:140]}
+
+
+def test_engine_use_ref_qual():
+    lr = "ACGTACGTACGT" * 10
+    engine = ConsensusEngine(ConsensusParams(trim=False, use_ref_qual=True))
+    aset = AlnSet("lr1", len(lr), params=engine.params)  # no alignments
+    refs = pack_reads([SeqRecord("lr1", lr, qual=np.full(len(lr), 30, np.uint8))])
+    res = engine.consensus_batch(refs, [aset])[0]
+    # ref votes alone reproduce the read with phred from its own freq
+    assert res.record.seq == lr
+    assert res.record.qual.min() > 0
+
+
+def test_engine_uncovered_emits_ref():
+    lr = "ACGTACGTAC"
+    engine = ConsensusEngine(ConsensusParams(trim=False))
+    aset = AlnSet("lr1", len(lr), params=engine.params)
+    refs = pack_reads([SeqRecord("lr1", lr)])
+    res = engine.consensus_batch(refs, [aset])[0]
+    assert res.record.seq == lr
+    assert np.all(res.record.qual == 0)
+    assert res.cigar == "10M"
+
+
+def test_engine_chimera_detection():
+    rng = random.Random(9)
+    a = "".join(rng.choice("ACGT") for _ in range(500))
+    b = "".join(rng.choice("ACGT") for _ in range(500))
+    # genome-A continues past the junction with cont_a (what left-locus reads
+    # actually contain there); genome-B similarly precedes b with cont_b
+    cont_a = "".join(rng.choice("ACGT") for _ in range(80))
+    cont_b = "".join(rng.choice("ACGT") for _ in range(80))
+    lr = a + b  # chimeric long read, junction at 500
+    ext_a = a + cont_a          # what left reads are drawn from
+    ext_b = cont_b + b          # right reads; lr pos p -> ext_b index p-500+80
+
+    engine = ConsensusEngine(ConsensusParams(trim=False))
+    aset = AlnSet("chim", len(lr), params=engine.params)
+    # dense background coverage away from the junction (high bin fill);
+    # right-side reads start exactly at the junction, as a mapper would place
+    # pure-B reads
+    for s in range(0, 441, 4):
+        aset.alns.append(aln(s, a[s : s + 60], "60M", score=300, qname=f"l{s}"))
+    for s in range(500, 940, 4):
+        aset.alns.append(aln(s, b[s - 500 : s - 440], "60M", score=300, qname=f"r{s}"))
+    # sparse junction-crossing left-locus reads carrying cont_a past 500
+    # (low bin fill at the junction bins 24-26)
+    for s in (452, 468, 484):
+        aset.alns.append(aln(s, ext_a[s : s + 60], "60M", score=300, qname=f"xl{s}"))
+    del ext_b  # unused: right reads never cross in this scenario
+
+    refs = pack_reads([SeqRecord("chim", lr)])
+    res = engine.consensus_batch(refs, [aset], detect_chimera=True)[0]
+
+    # clean read control at the same coverage profile
+    aset2 = AlnSet("clean", len(lr), params=engine.params)
+    for s in range(0, len(lr) - 60, 4):
+        aset2.alns.append(aln(s, lr[s : s + 60], "60M", score=300, qname=f"c{s}"))
+    res2 = engine.consensus_batch(refs, [aset2], detect_chimera=True)[0]
+    assert res2.chimera == []
+
+    assert len(res.chimera) >= 1
+    f, t, score = res.chimera[0]
+    assert 380 <= f <= 620, (f, t, score)
+    assert score > 0.3

@@ -247,7 +247,8 @@ def test_pack_reads_shapes_and_roundtrip():
     rng = random.Random(3)
     recs = [synth_record(rng, f"r{i}", rng.randrange(1, 200)) for i in range(17)]
     b = pack_reads(recs, pad_multiple=128)
-    assert b.codes.shape == (17, 128) if max(len(r) for r in recs) <= 128 else True
+    expected_pad = -(-max(len(r) for r in recs) // 128) * 128
+    assert b.codes.shape == (17, expected_pad)
     assert b.codes.shape == b.qual.shape
     assert b.position_mask().sum() == sum(len(r) for r in recs)
     back = b.to_records()
