@@ -1,0 +1,11 @@
+"""Alignment subsystem: seeding + batched SW extension (the TPU replacement
+for the reference's native mappers, SURVEY §2.2)."""
+
+from proovread_tpu.align.params import AlignParams, TASK_PARAMS
+from proovread_tpu.align.mapper import JaxMapper, MapResult
+from proovread_tpu.align.sw import sw_batch, ops_to_cigar
+
+__all__ = [
+    "AlignParams", "TASK_PARAMS", "JaxMapper", "MapResult",
+    "sw_batch", "ops_to_cigar",
+]

@@ -1,0 +1,141 @@
+"""JaxMapper: the device mapper replacing bwa-proovread / SHRiMP / blasr.
+
+Pipeline per task: seed (host k-mer index) -> extract candidate ref windows
+-> vmapped SW extension + traceback on device -> threshold (per-base ``-T``,
+``proovread.cfg:325``) -> Alignment records grouped into per-long-read
+``AlnSet``s. Score-binned coverage admission (the bwa-proovread ``-b/-l``
+in-mapper binning, ``README.org:228-237``) is applied by ``AlnSet.admit``
+downstream, so the whole mapping stays within the reference's admission
+semantics while the expensive extension runs as one batched kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from proovread_tpu.align import seed as seed_mod
+from proovread_tpu.align.params import AlignParams
+from proovread_tpu.align.sw import ops_to_cigar, sw_batch
+from proovread_tpu.consensus.alnset import Alignment, AlnSet
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.batch import ReadBatch
+
+FLAG_REVERSE = 16
+FLAG_SECONDARY = 256
+
+
+def _round_up(n: int, m: int) -> int:
+    return max(m, ((n + m - 1) // m) * m)
+
+
+@dataclass
+class MapResult:
+    alnsets: List[AlnSet]          # one per long read, index-aligned to refs
+    n_candidates: int = 0
+    n_passed: int = 0
+
+
+class JaxMapper:
+    def __init__(
+        self,
+        params: Optional[AlignParams] = None,
+        chunk_rows: int = 2048,
+    ):
+        self.params = params or AlignParams()
+        self.chunk_rows = chunk_rows
+
+    def map_batch(
+        self,
+        refs: ReadBatch,
+        queries: ReadBatch,
+        cns_params: Optional[ConsensusParams] = None,
+    ) -> MapResult:
+        p = self.params
+        cns = cns_params or ConsensusParams()
+        B, L = refs.codes.shape
+        alnsets = [
+            AlnSet(ref_id=refs.ids[i], ref_len=int(refs.lengths[i]), params=cns)
+            for i in range(B)
+        ]
+
+        rc_codes = seed_mod.revcomp_batch(queries.codes, queries.lengths)
+        index = seed_mod.build_index(refs.codes, refs.lengths, p.min_seed_len)
+        cand = seed_mod.find_candidates(
+            index, queries.codes, queries.lengths, p, rc=rc_codes
+        )
+        n_cand = len(cand.sread)
+        if n_cand == 0:
+            return MapResult(alnsets, 0, 0)
+
+        m = queries.pad_len
+        n = _round_up(m + 2 * p.band_width, 128)
+
+        # candidate window starts, clipped into the padded ref array
+        win_start = cand.diag - p.band_width
+        win_start = np.clip(win_start, 0, max(0, L - n))
+        if L >= n:
+            ref_windows = np.lib.stride_tricks.sliding_window_view(
+                refs.codes, n, axis=1
+            )
+        else:
+            pad = np.full((B, n - L), 4, np.int8)  # N padding
+            ref_windows = np.lib.stride_tricks.sliding_window_view(
+                np.concatenate([refs.codes, pad], axis=1), n, axis=1
+            )
+
+        n_passed = 0
+        for start in range(0, n_cand, self.chunk_rows):
+            sl = slice(start, min(start + self.chunk_rows, n_cand))
+            R = sl.stop - sl.start
+            # materialize only this chunk's query/window copies
+            qc = np.full((self.chunk_rows, m), 4, np.int8)
+            rcw = np.full((self.chunk_rows, n), 4, np.int8)
+            ql = np.zeros(self.chunk_rows, np.int32)
+            qc[:R] = np.where(cand.strand[sl, None] == 0,
+                              queries.codes[cand.sread[sl]],
+                              rc_codes[cand.sread[sl]])
+            rcw[:R] = ref_windows[cand.lread[sl], win_start[sl]]
+            ql[:R] = queries.lengths[cand.sread[sl]]
+
+            res = sw_batch(jnp.asarray(qc), jnp.asarray(rcw), jnp.asarray(ql), p)
+            score = np.asarray(res.score)[:R]
+            q_start = np.asarray(res.q_start)[:R]
+            q_end = np.asarray(res.q_end)[:R]
+            r_start = np.asarray(res.r_start)[:R]
+            ops_rev = np.asarray(res.ops_rev)[:R]
+            n_ops = np.asarray(res.n_ops)[:R]
+
+            thr = np.array([p.threshold(q) for q in ql[:R]])
+            passed = np.flatnonzero(score >= thr)
+            n_passed += len(passed)
+            for j in passed:
+                ci = start + j
+                li = int(cand.lread[ci])
+                qlen = int(ql[j])
+                ops, lens = ops_to_cigar(
+                    ops_rev[j], int(n_ops[j]), int(q_start[j]), int(q_end[j]), qlen
+                )
+                if len(ops) == 0:
+                    continue
+                si = int(cand.sread[ci])
+                strand = int(cand.strand[ci])
+                seq = (rc_codes if strand else queries.codes)[si, :qlen]
+                qual = queries.qual[si, :qlen]
+                if strand:
+                    qual = qual[::-1]
+                pos0 = int(win_start[ci]) + int(r_start[j])
+                alnsets[li].alns.append(Alignment(
+                    qname=queries.ids[si],
+                    pos0=pos0,
+                    seq_codes=seq.copy(),
+                    ops=ops,
+                    lens=lens,
+                    qual=qual.copy(),
+                    score=float(score[j]),
+                    flag=FLAG_REVERSE if strand else 0,
+                ))
+        return MapResult(alnsets, n_cand, n_passed)

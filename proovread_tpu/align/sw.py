@@ -1,0 +1,223 @@
+"""Affine-gap Smith-Waterman with clip penalties, in JAX.
+
+This is the TPU-native replacement for the reference's native aligners
+(bwa-proovread / SHRiMP / blasr — SURVEY §2.2): one fixed-shape kernel,
+vmapped over a batch of (query, ref-window) candidate pairs produced by the
+seeder. Row-parallel DP: a ``lax.scan`` over query rows; within a row the
+deletion state's sequential dependency is solved with a running-max transform
+(``E[j] = cummax(H'[k] + k*e) - o - e - j*e``), which is exact because
+re-opening a deletion immediately after closing one can never beat extending
+it while ``o_del >= 0``.
+
+Clip handling follows bwa's ``-L``: starting the alignment past query
+position 0 costs ``clip``, and ending before the query end costs ``clip`` at
+end-cell selection; reported scores are raw local scores (clip penalties
+undone), like bwa's AS tag.
+
+Traceback runs on-device as a vmapped ``lax.scan`` over packed per-cell
+direction bits, emitting one op per step (M/I/D, cigar.py codes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from proovread_tpu.align.params import AlignParams
+
+NEG = jnp.float32(-1e9)
+
+# direction-bit layout (uint8 per DP cell)
+#   bits 0-1: H' source: 0 = M starting the alignment, 1 = M continuing, 2 = F(ins)
+#   bit 2:    H realized by E (deletion) rather than H'
+#   bit 3:    E extends the previous deletion (vs opening from H')
+#   bit 4:    F extends the previous insertion (vs opening from H)
+_SRC_MASK = 3
+_BIT_E = 4
+_BIT_EEXT = 8
+_BIT_FEXT = 16
+
+# traceback modes
+_FULL, _HPRIME, _EMODE, _FMODE, _DONE = 0, 1, 2, 3, 4
+
+# emitted op codes == consensus.cigar codes
+OP_M, OP_I, OP_D, OP_NONE = 0, 1, 2, 3
+
+
+class SWResult(NamedTuple):
+    score: jnp.ndarray      # f32 [R]  raw local score (clip penalties undone)
+    sel_score: jnp.ndarray  # f32 [R]  clip-penalized selection score
+    q_start: jnp.ndarray    # i32 [R]  first aligned query base (head clip len)
+    q_end: jnp.ndarray      # i32 [R]  one past last aligned query base
+    r_start: jnp.ndarray    # i32 [R]  window-relative ref start
+    r_end: jnp.ndarray      # i32 [R]  one past last aligned ref pos
+    ops_rev: jnp.ndarray    # i8  [R, m+n] ops end->start, OP_NONE padded
+    n_ops: jnp.ndarray      # i32 [R]
+
+
+def _sub_table(p: AlignParams) -> np.ndarray:
+    """6x6 substitution scores over the code alphabet (N/GAP ambiguous)."""
+    t = np.full((6, 6), -float(p.mismatch), np.float32)
+    for b in range(4):
+        t[b, b] = float(p.match)
+    t[4, :] = t[:, 4] = -float(p.n_penalty)
+    t[5, :] = t[:, 5] = -float(p.n_penalty)
+    return t
+
+
+def _dp_one(q, r, qlen, sub, o_del, e_del, o_ins, e_ins, clip):
+    """DP over one (query [m], ref [n]) pair. Returns (dirs [m,n] uint8,
+    best selection score, best raw-H, end i, end j)."""
+    m, n = q.shape[0], r.shape[0]
+    j_idx = jnp.arange(n, dtype=jnp.float32)
+    j_e = (j_idx + 1.0) * e_del  # DP column index (1-based) * e_del
+
+    sub_rows = sub[q][:, r]  # [m, n] substitution score per cell
+
+    def row(carry, inp):
+        h_prev, f_prev, i = carry  # rows are j=1..n
+        sub_row = inp
+        start_prev = jnp.where(i == 1, 0.0, -jnp.float32(clip))  # start at (i-1, *)
+        diag_shift = jnp.concatenate([jnp.full((1,), NEG), h_prev[:-1]])
+        diag_base = jnp.maximum(diag_shift, start_prev)
+        is_start = start_prev > diag_shift
+
+        # row 0 is the start boundary, not real cells: gaps may not open from
+        # it (no leading insertions — matches bwa)
+        f_open = jnp.where(i == 1, NEG, h_prev - (o_ins + e_ins))
+        f_ext = f_prev - e_ins
+        f_row = jnp.maximum(f_open, f_ext)
+        f_is_ext = f_ext > f_open
+
+        m_row = diag_base + sub_row
+        hp = jnp.maximum(m_row, f_row)
+        src = jnp.where(f_row > m_row, 2, jnp.where(is_start, 0, 1)).astype(jnp.uint8)
+
+        # E[j] = max_{k<j} H'[k] - o_del - (j-k) e_del, via running max of
+        # H'[k] + k*e_del (1-based k)
+        u = jax.lax.associative_scan(jnp.maximum, hp + j_e)
+        u_excl = jnp.concatenate([jnp.full((1,), NEG), u[:-1]])
+        e_row = u_excl - o_del - j_e
+        hp_shift = jnp.concatenate([jnp.full((1,), NEG), hp[:-1]])
+        e_shift = jnp.concatenate([jnp.full((1,), NEG), e_row[:-1]])
+        e_is_ext = (e_shift - e_del) >= (hp_shift - o_del - e_del)
+
+        h_row = jnp.maximum(hp, e_row)
+        h_is_e = e_row > hp
+
+        bits = (
+            src
+            | jnp.where(h_is_e, _BIT_E, 0).astype(jnp.uint8)
+            | jnp.where(e_is_ext, _BIT_EEXT, 0).astype(jnp.uint8)
+            | jnp.where(f_is_ext, _BIT_FEXT, 0).astype(jnp.uint8)
+        )
+        return (h_row, f_row, i + 1), (bits, h_row)
+
+    init = (jnp.zeros(n, jnp.float32), jnp.full(n, NEG), jnp.int32(1))
+    _, (dirs, h_all) = jax.lax.scan(row, init, sub_rows)
+
+    # end-cell selection: tail clip costs `clip` unless the alignment reaches
+    # the query end (row qlen); rows past qlen are padding
+    i_idx = jnp.arange(1, m + 1)
+    tail_pen = jnp.where(i_idx == qlen, 0.0, jnp.float32(clip))[:, None]
+    valid = (i_idx <= qlen)[:, None]
+    sel = jnp.where(valid, h_all - tail_pen, NEG)
+    flat = jnp.argmax(sel)
+    ei, ej = flat // n, flat % n
+    return dirs, sel[ei, ej], h_all[ei, ej], ei + 1, ej + 1
+
+
+def _traceback_one(dirs, ei, ej, max_steps):
+    """Walk direction bits from (ei, ej) back to the alignment start,
+    emitting one op per scan step (end->start order)."""
+
+    def step(carry, _):
+        i, j, mode, done = carry
+        b = dirs[i - 1, j - 1].astype(jnp.int32)
+        src = b & _SRC_MASK
+        mode = jnp.where(mode == _FULL,
+                         jnp.where(b & _BIT_E != 0, _EMODE, _HPRIME), mode)
+        mode = jnp.where((mode == _HPRIME) & (src == 2), _FMODE, mode)
+
+        op = jnp.where(done, OP_NONE,
+             jnp.where(mode == _EMODE, OP_D,
+             jnp.where(mode == _FMODE, OP_I, OP_M))).astype(jnp.int8)
+
+        ni = jnp.where(mode == _EMODE, i, i - 1)
+        nj = jnp.where(mode == _FMODE, j, j - 1)
+        nmode = jnp.where(mode == _EMODE,
+                          jnp.where(b & _BIT_EEXT != 0, _EMODE, _HPRIME),
+                jnp.where(mode == _FMODE,
+                          jnp.where(b & _BIT_FEXT != 0, _FMODE, _FULL),
+                          jnp.where(src == 0, _DONE, _FULL)))
+        ndone = done | (nmode == _DONE) | (ni <= 0) | (nj <= 0)
+        ni = jnp.where(done, i, ni)
+        nj = jnp.where(done, j, nj)
+        nmode = jnp.where(done, mode, nmode)
+        return (ni, nj, nmode, ndone), op
+
+    (si, sj, _, _), ops = jax.lax.scan(
+        step, (ei, ej, jnp.int32(_FULL), jnp.bool_(False)), None, length=max_steps
+    )
+    n_ops = (ops != OP_NONE).sum()
+    return ops, n_ops, si, sj
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def sw_batch(q, r, qlen, params: AlignParams) -> SWResult:
+    """Align a batch of queries to ref windows.
+
+    q: i8 [R, m] query codes (N-padded); r: i8 [R, n] ref window codes;
+    qlen: i32 [R]. Static shapes; one compilation per (m, n).
+    """
+    R, m = q.shape
+    n = r.shape[1]
+    sub = jnp.asarray(_sub_table(params))
+
+    dp = functools.partial(
+        _dp_one, sub=sub,
+        o_del=float(params.o_del), e_del=float(params.e_del),
+        o_ins=float(params.o_ins), e_ins=float(params.e_ins),
+        clip=float(params.clip),
+    )
+    dirs, sel_score, h_best, ei, ej = jax.vmap(dp)(q, r, qlen)
+    ops_rev, n_ops, si, sj = jax.vmap(
+        functools.partial(_traceback_one, max_steps=m + n)
+    )(dirs, ei, ej)
+
+    q_start = si  # (si, sj) is the cell *before* the first M
+    r_start = sj
+    head_clipped = q_start > 0
+    score = h_best + jnp.where(head_clipped, float(params.clip), 0.0)
+    return SWResult(
+        score=score, sel_score=sel_score,
+        q_start=q_start, q_end=ei, r_start=r_start, r_end=ej,
+        ops_rev=ops_rev, n_ops=n_ops,
+    )
+
+
+def ops_to_cigar(ops_rev: np.ndarray, n_ops: int, q_start: int, q_end: int,
+                 qlen: int):
+    """Host: reversed op stream -> (ops, lens) arrays with soft clips.
+
+    Returns arrays in consensus.cigar op codes (M=0 I=1 D=2 S=3)."""
+    path = ops_rev[:n_ops][::-1]
+    out_ops, out_lens = [], []
+    if q_start > 0:
+        out_ops.append(3)
+        out_lens.append(int(q_start))
+    if n_ops:
+        change = np.flatnonzero(np.diff(path)) + 1
+        bounds = np.concatenate([[0], change, [len(path)]])
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            out_ops.append(int(path[a]))
+            out_lens.append(int(b - a))
+    tail = qlen - q_end
+    if tail > 0:
+        out_ops.append(3)
+        out_lens.append(int(tail))
+    return np.array(out_ops, np.uint8), np.array(out_lens, np.int32)

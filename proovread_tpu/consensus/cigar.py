@@ -121,8 +121,9 @@ def _trim_taboo(
                 if i < len(ops) - 1:
                     tail_cut = tail - int(lens[i])
                     ops, lens = ops[: i + 1], lens[: i + 1]
-                    seq = seq[:-tail_cut]
-                    qual = qual[:-tail_cut]
+                    if tail_cut > 0:  # seq[:-0] would empty the array
+                        seq = seq[:-tail_cut]
+                        qual = qual[:-tail_cut]
                 break
         elif ops[i] == I:
             tail += int(lens[i])
@@ -227,9 +228,7 @@ def expand_alignment(
             take = min(len(extra), K - int(ins_len[tgt]))
             if take > 0:
                 ins_bases[tgt, ins_len[tgt] : ins_len[tgt] + take] = extra[:take]
-                ins_len[tgt] += len(extra)  # true length for vote, bases capped
-            else:
-                ins_len[tgt] += len(extra)
+            ins_len[tgt] += len(extra)  # true length for vote, bases capped
             if len(extraq):
                 freq_q[tgt] = min(int(freq_q[tgt]), int(extraq.min()))
             qpos += ln

@@ -161,9 +161,11 @@ class ConsensusEngine:
         assert len(alnsets) == B
 
         for aset in alnsets:
-            aset.filter_by_scores()
             if aset.bin_bases is None:
+                aset.filter_by_scores()
                 aset.admit()
+            # pre-admitted sets keep their bin bookkeeping untouched —
+            # re-filtering here would desync aln_bins/bin_bases from alns
 
         expanded = self._expand_sets(alnsets)
 

@@ -116,6 +116,15 @@ def test_taboo_trim_tail():
     assert cs2.span == 100
 
 
+def test_taboo_trim_tail_zero_cut():
+    # trailing D only: the crossing M-run contributes the whole tail, so
+    # tail_cut == 0 — regression for seq[:-0] emptying the sequence
+    p = ConsensusParams(min_aln_length=50)
+    cs = expand_alignment(0, *parse_cigar("20M1I70M3D"), encode_ascii("A" * 91), None, p)
+    assert cs is not None
+    assert cs.span == 90  # 20M + 70M; trailing 3D cut, no query bases lost
+
+
 def test_taboo_keep_rule():
     # a head cut that leaves <50 bp drops the alignment (Sam/Seq.pm:352-354)
     p = ConsensusParams(min_aln_length=50)
