@@ -57,6 +57,8 @@ class SWResult(NamedTuple):
     r_end: jnp.ndarray      # i32 [R]  one past last aligned ref pos
     ops_rev: jnp.ndarray    # i8  [R, m+n] ops end->start, OP_NONE padded
     n_ops: jnp.ndarray      # i32 [R]
+    step_i: jnp.ndarray     # i16 [R, m+n] DP row of each emitted op (1-based)
+    step_j: jnp.ndarray     # i16 [R, m+n] DP col of each emitted op (1-based)
 
 
 def _sub_table(p: AlignParams) -> np.ndarray:
@@ -158,13 +160,15 @@ def _traceback_one(dirs, ei, ej, max_steps):
         ni = jnp.where(done, i, ni)
         nj = jnp.where(done, j, nj)
         nmode = jnp.where(done, mode, nmode)
-        return (ni, nj, nmode, ndone), op
+        out = (op, jnp.where(done, 0, i).astype(jnp.int16),
+               jnp.where(done, 0, j).astype(jnp.int16))
+        return (ni, nj, nmode, ndone), out
 
-    (si, sj, _, _), ops = jax.lax.scan(
+    (si, sj, _, _), (ops, step_i, step_j) = jax.lax.scan(
         step, (ei, ej, jnp.int32(_FULL), jnp.bool_(False)), None, length=max_steps
     )
     n_ops = (ops != OP_NONE).sum()
-    return ops, n_ops, si, sj
+    return ops, n_ops, si, sj, step_i, step_j
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
@@ -185,7 +189,7 @@ def sw_batch(q, r, qlen, params: AlignParams) -> SWResult:
         clip=float(params.clip),
     )
     dirs, sel_score, h_best, ei, ej = jax.vmap(dp)(q, r, qlen)
-    ops_rev, n_ops, si, sj = jax.vmap(
+    ops_rev, n_ops, si, sj, step_i, step_j = jax.vmap(
         functools.partial(_traceback_one, max_steps=m + n)
     )(dirs, ei, ej)
 
@@ -196,7 +200,7 @@ def sw_batch(q, r, qlen, params: AlignParams) -> SWResult:
     return SWResult(
         score=score, sel_score=sel_score,
         q_start=q_start, q_end=ei, r_start=r_start, r_end=ej,
-        ops_rev=ops_rev, n_ops=n_ops,
+        ops_rev=ops_rev, n_ops=n_ops, step_i=step_i, step_j=step_j,
     )
 
 

@@ -67,6 +67,51 @@ class Alignment:
         return ns * (self.span / (NCSCORE_CONSTANT + self.span))
 
 
+def admit_mask(
+    read_idx: np.ndarray,    # i32 [R] target long read per alignment
+    pos0: np.ndarray,        # i32 [R] 0-based ref position
+    span: np.ndarray,        # i32 [R] reference span (M+D)
+    score: np.ndarray,       # f32 [R] alignment score (AS)
+    ref_lens: np.ndarray,    # i32 [B] long-read lengths
+    params: ConsensusParams,
+    valid: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Vectorized score-binned admission over flat candidate arrays — the
+    array-level twin of :meth:`AlnSet.admit` (``Sam/Seq.pm:582-614``) used by
+    the fused device path. Returns a bool keep-mask."""
+    R = len(read_idx)
+    keep = np.ones(R, bool) if valid is None else valid.copy()
+    keep &= span > 0
+    ncscore = np.where(span > 0, score / (NCSCORE_CONSTANT + span), -np.inf)
+    if params.min_score is not None:
+        keep &= score >= params.min_score
+    if params.min_nscore is not None:
+        keep &= np.where(span > 0, score / np.maximum(span, 1), -np.inf) >= params.min_nscore
+    if params.min_ncscore is not None:
+        keep &= ncscore >= params.min_ncscore
+    if not keep.any():
+        return keep
+
+    bs = params.bin_size
+    n_bins = ref_lens.astype(np.int64) // bs + 1
+    bin_of = ((pos0 + 1 + span / 2) / bs).astype(np.int64)
+    bin_of = np.clip(bin_of, 0, n_bins[read_idx] - 1)
+    gbin = read_idx.astype(np.int64) * int(n_bins.max()) + bin_of
+
+    idx = np.flatnonzero(keep)
+    order = idx[np.lexsort((idx, -ncscore[idx], gbin[idx]))]
+    sbins = gbin[order]
+    sspans = span[order].astype(np.float64)
+    cum = np.cumsum(sspans)
+    first = np.searchsorted(sbins, sbins)
+    before_bin = np.where(first > 0, cum[first - 1], 0.0)
+    cum_before = cum - sspans - before_bin
+    admit = cum_before <= params.bin_max_bases
+    out = np.zeros(R, bool)
+    out[order[admit]] = True
+    return out
+
+
 @dataclass
 class AlnSet:
     """Alignments of one long read, plus admission bookkeeping."""

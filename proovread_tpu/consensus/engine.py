@@ -219,49 +219,10 @@ class ConsensusEngine:
     def _assemble(
         self, rid, emitted, base, ins_len, ins_bases, freq, phred, coverage
     ) -> ConsensusResult:
-        n = len(emitted)
-        emit_counts = np.where(emitted, 1 + ins_len, 0)
-        total = int(emit_counts.sum())
-        seq = np.zeros(total, np.int8)
-        quals = np.zeros(total, np.uint8)
-        freqs = np.zeros(total, np.float32)
-        # target offset of each column's first emitted base
-        offs = np.concatenate([[0], np.cumsum(emit_counts)[:-1]])
-        em = emitted.astype(bool)
-        seq[offs[em]] = base[em]
-        quals[offs[em]] = phred[em]
-        freqs[offs[em]] = freq[em]
-        ins_cols = np.flatnonzero(em & (ins_len > 0))
-        for c in ins_cols:
-            k = int(ins_len[c])
-            o = int(offs[c]) + 1
-            seq[o : o + k] = ins_bases[c, :k]
-            quals[o : o + k] = phred[c]
-            freqs[o : o + k] = freq[c]
-
-        # consensus cigar: M per emitted column (+D per extra base), I per
-        # dropped column — Sam::Seq trace semantics (Sam/Seq.pm:1625-1635)
-        cigar_parts = []
-        run_char, run_len = None, 0
-        for c in range(n):
-            chars = "I" if not em[c] else ("M" + "D" * int(ins_len[c]))
-            for ch in chars:
-                if ch == run_char:
-                    run_len += 1
-                else:
-                    if run_char is not None:
-                        cigar_parts.append(f"{run_len}{run_char}")
-                    run_char, run_len = ch, 1
-        if run_char is not None:
-            cigar_parts.append(f"{run_len}{run_char}")
-
-        rec = SeqRecord(id=rid, seq=decode_codes(seq), qual=quals)
-        return ConsensusResult(
-            record=rec,
-            freqs=freqs,
-            coverage=coverage,
-            cigar="".join(cigar_parts),
+        return assemble_consensus(
+            rid, emitted, base, ins_len, ins_bases, freq, phred, coverage
         )
+
 
     # -- chimera (Sam/Seq.pm:774-888 + bam2cns:461-491) ------------------
     def _chimera(
@@ -376,6 +337,56 @@ class ConsensusEngine:
                 pos_corr += ln
         emit[col:] = pos_corr
         return emit
+
+
+def assemble_consensus(
+    rid, emitted, base, ins_len, ins_bases, freq, phred, coverage
+) -> ConsensusResult:
+    """Host assembly of one read's consensus call: emitted columns + inserted
+    bases -> sequence/qual/freq arrays and the trace cigar (M per emitted
+    column, +D per inserted base, I per dropped column — Sam::Seq trace
+    semantics, Sam/Seq.pm:1625-1635)."""
+    n = len(emitted)
+    emit_counts = np.where(emitted, 1 + ins_len, 0)
+    total = int(emit_counts.sum())
+    seq = np.zeros(total, np.int8)
+    quals = np.zeros(total, np.uint8)
+    freqs = np.zeros(total, np.float32)
+    # target offset of each column's first emitted base
+    offs = np.concatenate([[0], np.cumsum(emit_counts)[:-1]])
+    em = emitted.astype(bool)
+    seq[offs[em]] = base[em]
+    quals[offs[em]] = phred[em]
+    freqs[offs[em]] = freq[em]
+    ins_cols = np.flatnonzero(em & (ins_len > 0))
+    for c in ins_cols:
+        k = int(ins_len[c])
+        o = int(offs[c]) + 1
+        seq[o : o + k] = ins_bases[c, :k]
+        quals[o : o + k] = phred[c]
+        freqs[o : o + k] = freq[c]
+
+    cigar_parts = []
+    run_char, run_len = None, 0
+    for c in range(n):
+        chars = "I" if not em[c] else ("M" + "D" * int(ins_len[c]))
+        for ch in chars:
+            if ch == run_char:
+                run_len += 1
+            else:
+                if run_char is not None:
+                    cigar_parts.append(f"{run_len}{run_char}")
+                run_char, run_len = ch, 1
+    if run_char is not None:
+        cigar_parts.append(f"{run_len}{run_char}")
+
+    rec = SeqRecord(id=rid, seq=decode_codes(seq), qual=quals)
+    return ConsensusResult(
+        record=rec,
+        freqs=freqs,
+        coverage=coverage,
+        cigar="".join(cigar_parts),
+    )
 
 
 def _hx(col: np.ndarray) -> float:

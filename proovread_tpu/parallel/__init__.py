@@ -1,0 +1,20 @@
+"""Multi-chip parallelism: device meshes and sharded correction steps.
+
+The reference's outermost parallelism is share-nothing job-level chunking of
+the long-read set (SURVEY §2.3); here that becomes a 2D
+``jax.sharding.Mesh``: the ``dp`` axis shards long reads / alignment
+candidates across chips (ICI), and ``sp`` shards the long-read length axis
+of the pileup/consensus tensors (sequence parallelism). Collectives are
+inserted by GSPMD; the only cross-shard traffic is candidate->read scatter
+and scalar metric reductions, matching the reference's "filesystem
+interconnect" being limited to chunk merge + global masked-% stats
+(``bin/proovread:1640-1718``).
+"""
+
+from proovread_tpu.parallel.mesh import (
+    make_mesh,
+    shard_batch,
+    sharded_call_consensus,
+)
+
+__all__ = ["make_mesh", "shard_batch", "sharded_call_consensus"]

@@ -1,0 +1,6 @@
+"""Pipeline layer: the fast fused correction step and (M3) the iterative
+masking driver replacing ``bin/proovread``'s task state machine."""
+
+from proovread_tpu.pipeline.correct import FastCorrector, CorrectionStats
+
+__all__ = ["FastCorrector", "CorrectionStats"]

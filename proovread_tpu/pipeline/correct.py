@@ -1,0 +1,194 @@
+"""FastCorrector: one fused map+consensus pass over a long-read batch.
+
+The fast twin of ``JaxMapper.map_batch`` + ``ConsensusEngine``: SW results
+stay on device; only O(R) scalars come to host for threshold + score-binned
+admission (exact ``add_aln_by_score`` parity via ``alnset.admit_mask``), then
+traceback streams are scatter-added straight into the pileup
+(``ops/fused.py``) and the consensus is called in one kernel. This is the
+analog of one ``bwa-sr-N`` mapping task plus its ``bam2cns`` fan-out
+(``bin/proovread:835-869`` + ``:1528-1721``) without BAM or process
+boundaries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from proovread_tpu.align import seed as seed_mod
+from proovread_tpu.align.params import AlignParams
+from proovread_tpu.align.sw import sw_batch
+from proovread_tpu.consensus.alnset import admit_mask
+from proovread_tpu.consensus.engine import ConsensusResult, assemble_consensus
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.batch import ReadBatch
+from proovread_tpu.ops import pileup as pileup_ops
+from proovread_tpu.ops.consensus_call import call_consensus
+from proovread_tpu.ops.fused import add_ref_votes, fused_accumulate
+
+
+def _round_up(n: int, m: int) -> int:
+    return max(m, ((n + m - 1) // m) * m)
+
+
+@dataclass
+class CorrectionStats:
+    n_candidates: int = 0
+    n_admitted: int = 0
+
+
+class FastCorrector:
+    def __init__(
+        self,
+        align_params: Optional[AlignParams] = None,
+        cns_params: Optional[ConsensusParams] = None,
+        chunk_rows: int = 4096,
+    ):
+        self.align_params = align_params or AlignParams()
+        self.cns_params = cns_params or ConsensusParams()
+        self.chunk_rows = chunk_rows
+
+    def correct_batch(
+        self,
+        refs: ReadBatch,
+        queries: ReadBatch,
+        ignore_coords: Optional[Sequence[Sequence[Tuple[int, int]]]] = None,
+    ) -> Tuple[List[ConsensusResult], CorrectionStats]:
+        p = self.align_params
+        cns = self.cns_params
+        B, L = refs.codes.shape
+
+        rc_codes = seed_mod.revcomp_batch(queries.codes, queries.lengths)
+        index = seed_mod.build_index(refs.codes, refs.lengths, p.min_seed_len)
+        cand = seed_mod.find_candidates(
+            index, queries.codes, queries.lengths, p, rc=rc_codes
+        )
+        n_cand = len(cand.sread)
+
+        m = queries.pad_len
+        n = _round_up(m + 2 * p.band_width, 128)
+        win_start = np.clip(cand.diag - p.band_width, 0, max(0, L - n))
+        if L >= n:
+            ref_windows = np.lib.stride_tricks.sliding_window_view(
+                refs.codes, n, axis=1)
+        else:
+            ref_windows = np.lib.stride_tricks.sliding_window_view(
+                np.concatenate(
+                    [refs.codes, np.full((B, n - L), 4, np.int8)], axis=1),
+                n, axis=1)
+
+        # pass 1: SW all chunks, keep traceback tensors on device
+        chunks = []
+        scores, q_starts, q_ends, r_starts, r_ends = [], [], [], [], []
+        C = self.chunk_rows
+        for start in range(0, max(n_cand, 1), C):
+            sl = slice(start, min(start + C, n_cand))
+            R = max(sl.stop - sl.start, 0)
+            if R == 0:
+                break
+            qc = np.full((C, m), 4, np.int8)
+            rcw = np.full((C, n), 4, np.int8)
+            ql = np.zeros(C, np.int32)
+            qc[:R] = np.where(cand.strand[sl, None] == 0,
+                              queries.codes[cand.sread[sl]],
+                              rc_codes[cand.sread[sl]])
+            rcw[:R] = ref_windows[cand.lread[sl], win_start[sl]]
+            ql[:R] = queries.lengths[cand.sread[sl]]
+            res = sw_batch(jnp.asarray(qc), jnp.asarray(rcw), jnp.asarray(ql), p)
+            chunks.append((sl, res, qc, ql))
+            scores.append(np.asarray(res.score)[:R])
+            q_starts.append(np.asarray(res.q_start)[:R])
+            q_ends.append(np.asarray(res.q_end)[:R])
+            r_starts.append(np.asarray(res.r_start)[:R])
+            r_ends.append(np.asarray(res.r_end)[:R])
+
+        if chunks:
+            score = np.concatenate(scores)
+            q_start = np.concatenate(q_starts)
+            q_end = np.concatenate(q_ends)
+            r_start = np.concatenate(r_starts)
+            r_end = np.concatenate(r_ends)
+
+            if p.score_per_base:
+                thr = p.min_out_score * queries.lengths[cand.sread]
+            else:
+                thr = np.full(n_cand, p.min_out_score)
+            passed = score >= thr
+            span = r_end - r_start
+            pos0 = win_start + r_start
+            admitted = admit_mask(
+                cand.lread, pos0, span, score, refs.lengths, cns, valid=passed
+            )
+        else:
+            admitted = np.zeros(0, bool)
+
+        ignore = None
+        if ignore_coords is not None:
+            ig = np.zeros((B, L), bool)
+            for i, regions in enumerate(ignore_coords):
+                for off, ln in regions or []:
+                    ig[i, max(0, off): off + ln] = True
+            ignore = jnp.asarray(ig)
+
+        # pass 2: fused vote scatter
+        pile = pileup_ops.init_pileup(B, L, cns.ins_cap)
+        for sl, res, qc, ql in chunks:
+            R = sl.stop - sl.start
+            adm = np.zeros(C, bool)
+            adm[:R] = admitted[sl]
+            qualc = np.full((C, m), cns.fallback_phred, np.uint8)
+            fwdq = queries.qual[cand.sread[sl]]
+            revq = _reverse_quals(fwdq, queries.lengths[cand.sread[sl]])
+            qualc[:R] = np.where(cand.strand[sl, None] == 0, fwdq, revq)
+            pile = fused_accumulate(
+                pile,
+                res.ops_rev, res.step_i, res.step_j,
+                jnp.asarray(qc), jnp.asarray(qualc),
+                res.q_start, res.q_end,
+                jnp.asarray(np.pad(cand.lread[sl], (0, C - R)).astype(np.int32)),
+                jnp.asarray(np.pad(win_start[sl], (0, C - R)).astype(np.int32)),
+                jnp.asarray(adm),
+                ignore_mask=ignore,
+                qual_weighted=cns.qual_weighted,
+                taboo_frac=cns.indel_taboo,
+                taboo_abs=cns.indel_taboo_length or 0,
+                min_aln_length=cns.min_aln_length,
+            )
+
+        if cns.use_ref_qual:
+            pile = add_ref_votes(
+                pile, jnp.asarray(refs.codes),
+                jnp.asarray(refs.qual.astype(np.float32)),
+                jnp.asarray(refs.position_mask().astype(np.float32)),
+            )
+
+        call = call_consensus(pile, jnp.asarray(refs.codes), cns.max_ins_length)
+
+        emitted = np.asarray(call.emitted)
+        base = np.asarray(call.base)
+        ins_len = np.asarray(call.ins_len)
+        ins_bases = np.asarray(call.ins_bases)
+        freq = np.asarray(call.freq)
+        phred = np.asarray(call.phred)
+        coverage = np.asarray(call.coverage)
+
+        results = []
+        for i in range(B):
+            nn = int(refs.lengths[i])
+            results.append(assemble_consensus(
+                refs.ids[i], emitted[i, :nn], base[i, :nn], ins_len[i, :nn],
+                ins_bases[i, :nn], freq[i, :nn], phred[i, :nn],
+                coverage[i, :nn],
+            ))
+        return results, CorrectionStats(n_cand, int(admitted.sum()))
+
+
+def _reverse_quals(qual: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Reverse each row's first `lengths[i]` entries (strand flip)."""
+    R, m = qual.shape
+    cols = (lengths[:, None] - 1 - np.arange(m)[None, :]) % m
+    out = np.take_along_axis(qual, cols, axis=1)
+    return out

@@ -1,0 +1,161 @@
+"""Fused device path tests: pileup-tensor equivalence vs the exact host
+expansion path, and end-to-end FastCorrector accuracy."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from proovread_tpu.align.params import AlignParams
+from proovread_tpu.align.sw import ops_to_cigar, sw_batch
+from proovread_tpu.consensus.alnset import Alignment, AlnSet, admit_mask
+from proovread_tpu.consensus.engine import ConsensusEngine
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.batch import pack_reads
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops import pileup as pileup_ops
+from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
+from proovread_tpu.ops.fused import fused_accumulate
+from proovread_tpu.pipeline import FastCorrector
+
+P = AlignParams()
+
+
+def _noisy_copy(rng, genome, err=0.12):
+    out = []
+    for b in genome:
+        u = rng.random()
+        if u < err * 0.5:
+            out.append(int(rng.integers(0, 4)))
+            out.append(int(b))
+        elif u < err * 0.75:
+            continue
+        elif u < err:
+            out.append(int((b + 1) % 4))
+        else:
+            out.append(int(b))
+    return np.array(out, np.int8)
+
+
+def test_fused_pileup_matches_exact_expansion():
+    """With trimming off, the fused vote scatter must reproduce the host
+    State_matrix expansion bit-for-bit (incl. the 1D1I mismatch rewrite)."""
+    rng = np.random.default_rng(5)
+    G = 400
+    genome = rng.integers(0, 4, G).astype(np.int8)
+    noisy = _noisy_copy(rng, genome)
+    lr = pack_reads([SeqRecord("lr", decode_codes(noisy))])
+    B, L = lr.codes.shape
+
+    m = 64
+    Rq = 40
+    qc = np.full((Rq, m), 4, np.int8)
+    ql = np.zeros(Rq, np.int32)
+    for i in range(Rq):
+        st = int(rng.integers(0, G - 60))
+        qc[i, :60] = genome[st:st + 60]
+        ql[i] = 60
+
+    cns = ConsensusParams(trim=False, min_aln_length=20, indel_taboo=0.0)
+    rw = np.repeat(lr.codes, Rq, axis=0)
+    res = sw_batch(jnp.asarray(qc), jnp.asarray(rw), jnp.asarray(ql), P)
+
+    aset = AlnSet(ref_id="lr", ref_len=int(lr.lengths[0]), params=cns)
+    ops_rev = np.asarray(res.ops_rev)
+    n_ops = np.asarray(res.n_ops)
+    qst, qen, rst = (np.asarray(res.q_start), np.asarray(res.q_end),
+                     np.asarray(res.r_start))
+    for i in range(Rq):
+        ops, lens = ops_to_cigar(ops_rev[i], int(n_ops[i]), int(qst[i]),
+                                 int(qen[i]), int(ql[i]))
+        aset.alns.append(Alignment(
+            qname=f"s{i}", pos0=int(rst[i]), seq_codes=qc[i, :ql[i]].copy(),
+            ops=ops, lens=lens, qual=np.full(int(ql[i]), 30, np.uint8),
+            score=float(res.score[i])))
+
+    eng = ConsensusEngine(cns)
+    aset.filter_by_scores()
+    aset.admit()
+    pile_exact = eng._build_pileup(eng._expand_sets([aset]), L)
+
+    names = {a.qname for a in aset.alns}
+    adm = np.array([f"s{i}" in names for i in range(Rq)])
+    pile_f = fused_accumulate(
+        pileup_ops.init_pileup(B, L, cns.ins_cap),
+        res.ops_rev, res.step_i, res.step_j,
+        jnp.asarray(qc), jnp.asarray(np.full((Rq, m), 30, np.uint8)),
+        res.q_start, res.q_end,
+        jnp.asarray(np.zeros(Rq, np.int32)),
+        jnp.asarray(np.zeros(Rq, np.int32)),
+        jnp.asarray(adm),
+        qual_weighted=False, taboo_frac=0.0, taboo_abs=0,
+        min_aln_length=cns.min_aln_length)
+
+    for name in ["counts", "ins_mbase", "ins_len_votes", "ins_base_votes"]:
+        a = np.asarray(getattr(pile_exact, name))
+        b = np.asarray(getattr(pile_f, name))
+        np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_admit_mask_matches_alnset_admit():
+    rng = np.random.default_rng(9)
+    cns = ConsensusParams()
+    Rn = 300
+    ref_lens = np.array([900, 1100], np.int32)
+    read_idx = rng.integers(0, 2, Rn).astype(np.int32)
+    pos0 = rng.integers(0, 800, Rn).astype(np.int32)
+    span = rng.integers(60, 110, Rn).astype(np.int32)
+    score = rng.uniform(100, 500, Rn).astype(np.float32)
+
+    mask = admit_mask(read_idx, pos0, span, score, ref_lens, cns)
+
+    for b in range(2):
+        aset = AlnSet(ref_id=f"r{b}", ref_len=int(ref_lens[b]), params=cns)
+        sel = np.flatnonzero(read_idx == b)
+        for i in sel:
+            ops = np.array([0], np.uint8)
+            lens = np.array([span[i]], np.int32)
+            aset.alns.append(Alignment(
+                qname=str(i), pos0=int(pos0[i]),
+                seq_codes=np.zeros(int(span[i]), np.int8),
+                ops=ops, lens=lens, score=float(score[i])))
+        aset.admit()
+        kept_ref = {a.qname for a in aset.alns}
+        kept_fused = {str(i) for i in sel if mask[i]}
+        assert kept_ref == kept_fused, f"read {b}"
+
+
+def test_fast_corrector_end_to_end():
+    rng = np.random.default_rng(42)
+    G = 1200
+    genome = rng.integers(0, 4, G).astype(np.int8)
+    noisy = _noisy_copy(rng, genome)
+    lr = pack_reads([SeqRecord("lr1", decode_codes(noisy))])
+
+    srs = []
+    for i in range(150):
+        st = int(rng.integers(0, G - 100))
+        seq = genome[st:st + 100].copy()
+        if rng.random() < 0.5:
+            seq = revcomp_codes(seq)
+        srs.append(SeqRecord(f"s{i}", decode_codes(seq),
+                             qual=np.full(100, 30, np.uint8)))
+    sr = pack_reads(srs)
+
+    fc = FastCorrector(cns_params=ConsensusParams(qual_weighted=True,
+                                                  use_ref_qual=True))
+    out, stats = fc.correct_batch(lr, sr)
+    assert stats.n_admitted > 40
+
+    loose = AlignParams(clip=0, score_per_base=False, min_out_score=0)
+
+    def ident(codes):
+        pad = ((max(len(codes), G) + 127) // 128) * 128 + 128
+        qp = np.full(pad, 4, np.int8); qp[:len(codes)] = codes
+        rp = np.full(pad, 4, np.int8); rp[:G] = genome
+        r = sw_batch(jnp.asarray(qp[None]), jnp.asarray(rp[None]),
+                     jnp.asarray([len(codes)], np.int32), loose)
+        return float(r.score[0]) / (5 * G)
+
+    raw = ident(noisy)
+    cor = ident(encode_ascii(out[0].record.seq))
+    assert cor > raw + 0.1
+    assert cor > 0.95, f"fused corrected identity {cor:.3f}"
