@@ -1,0 +1,112 @@
+"""Benchmark: corrected PacBio bases/sec/chip on the F.antasticus sample.
+
+Config #1 of BASELINE.json: the bundled 121 long reads (126,422 bp) corrected
+with ~30x simulated 100bp short reads (the sample's short-read blob is
+missing upstream, `.MISSING_LARGE_BLOBS:1`; reads are simulated from the
+bundled genome at 1% error, as SURVEY §7.3 prescribes).
+
+Baseline: the reference publishes exactly one end-to-end wall-clock — 315.5Mb
+corrected in ~59min on a 2015 ~20-core server (`README.org:193-204,277-279`)
+— i.e. ~89,000 corrected bases/sec for the whole CPU pipeline. BASELINE.json
+targets >=20x that on one v5e chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_BASES_PER_SEC = 89_000.0  # README.org:193-204: 315.5e6 bases / 59 min
+
+
+def main():
+    import jax
+    # persistent compile cache: steady-state numbers, not XLA compile time
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    from proovread_tpu.align.params import AlignParams
+    from proovread_tpu.align.sw import sw_batch
+    from proovread_tpu.consensus.params import ConsensusParams
+    from proovread_tpu.io import fasta, fastq
+    from proovread_tpu.io.batch import pack_reads
+    from proovread_tpu.io.records import SeqRecord
+    from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
+    from proovread_tpu.pipeline import FastCorrector
+    import jax.numpy as jnp
+
+    sample = "/root/reference/sample"
+    rng = np.random.default_rng(0)
+    genome = encode_ascii(
+        next(iter(fasta.FastaReader(f"{sample}/F.antasticus_genome.fa"))).seq)
+    G = len(genome)
+
+    srs = []
+    for i in range(30 * G // 100):
+        st = int(rng.integers(0, G - 100))
+        seq = genome[st:st + 100].copy()
+        for mu in np.flatnonzero(rng.random(100) < 0.01):
+            seq[mu] = (seq[mu] + 1 + rng.integers(0, 3)) % 4
+        if rng.random() < 0.5:
+            seq = revcomp_codes(seq)
+        srs.append(SeqRecord(f"s{i}", decode_codes(seq),
+                             qual=np.full(100, 30, np.uint8)))
+    sr = pack_reads(srs)
+
+    longs = list(fastq.FastqReader(f"{sample}/F.antasticus_long_error.fq"))
+    # pad the batch to a fixed bucket so every run compiles the same shapes
+    B_bucket = ((len(longs) + 31) // 32) * 32
+    dummies = [SeqRecord(f"_pad{i}", "A" * 8)
+               for i in range(B_bucket - len(longs))]
+    lr = pack_reads(longs + dummies)
+    total_bases = int(lr.lengths[:len(longs)].sum())
+
+    fc = FastCorrector(
+        cns_params=ConsensusParams(qual_weighted=True, use_ref_qual=True))
+
+    # warmup with identical shapes (first call pays XLA compiles)
+    fc.correct_batch(lr, sr)
+
+    t0 = time.time()
+    out, stats = fc.correct_batch(lr, sr)
+    dt = time.time() - t0
+    bases_per_sec = total_bases / dt
+
+    # accuracy spot check vs the bundled error-free originals
+    origs = {r.id.split("_")[2]: r
+             for r in fastq.FastqReader(f"{sample}/F.antasticus_long_orig.fq")}
+    loose = AlignParams(clip=0, score_per_base=False, min_out_score=0)
+
+    def ident(a, b):
+        pad = ((max(len(a), len(b)) + 127) // 128) * 128 + 128
+        qp = np.full(pad, 4, np.int8); qp[:len(a)] = a
+        rp = np.full(pad, 4, np.int8); rp[:len(b)] = b
+        r = sw_batch(jnp.asarray(qp[None]), jnp.asarray(rp[None]),
+                     jnp.asarray([len(a)], np.int32), loose)
+        return float(r.score[0]) / (5 * len(b))
+
+    idents = []
+    for i in range(0, len(longs), 12):
+        key = longs[i].id.split("_")[2] if longs[i].id.startswith("long_error_") else None
+        if key and key in origs:
+            idents.append(ident(encode_ascii(out[i].record.seq),
+                                encode_ascii(origs[key].seq)))
+    mean_ident = float(np.mean(idents)) if idents else 0.0
+
+    print(json.dumps({
+        "metric": "corrected_bases_per_sec_per_chip",
+        "value": round(bases_per_sec, 1),
+        "unit": "bases/sec/chip",
+        "vs_baseline": round(bases_per_sec / BASELINE_BASES_PER_SEC, 3),
+        "wall_s": round(dt, 2),
+        "n_reads": len(longs),
+        "n_candidates": stats.n_candidates,
+        "mean_identity_vs_orig": round(mean_ident, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

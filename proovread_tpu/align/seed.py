@@ -72,12 +72,15 @@ def build_index(codes: np.ndarray, lengths: np.ndarray, k: int) -> SeedIndex:
     """Index a packed long-read batch (int8 [B, L], N-padded)."""
     B, L = codes.shape
     vals, valid = _rolling_kmers(codes, k)
-    if vals.shape[1]:
-        valid &= (np.arange(vals.shape[1])[None, :] + k) <= lengths[:, None]
+    n_pos = vals.shape[1]
+    if n_pos:
+        valid &= (np.arange(n_pos)[None, :] + k) <= lengths[:, None]
     flat = np.flatnonzero(valid)
     v = vals.reshape(-1)[flat]
+    # re-stride from the [B, L-k+1] kmer grid to [B, L] coordinates
+    gpos = (flat // n_pos) * np.int64(L) + (flat % n_pos) if n_pos else flat
     order = np.argsort(v, kind="stable")
-    return SeedIndex(k=k, kmers=v[order], gpos=flat[order].astype(np.int64),
+    return SeedIndex(k=k, kmers=v[order], gpos=gpos[order].astype(np.int64),
                      length=L, n_reads=B)
 
 

@@ -82,11 +82,12 @@ def admit_mask(
     R = len(read_idx)
     keep = np.ones(R, bool) if valid is None else valid.copy()
     keep &= span > 0
-    ncscore = np.where(span > 0, score / (NCSCORE_CONSTANT + span), -np.inf)
+    eff = -score if params.invert_scores else score
+    ncscore = np.where(span > 0, eff / (NCSCORE_CONSTANT + span), -np.inf)
     if params.min_score is not None:
-        keep &= score >= params.min_score
+        keep &= eff >= params.min_score
     if params.min_nscore is not None:
-        keep &= np.where(span > 0, score / np.maximum(span, 1), -np.inf) >= params.min_nscore
+        keep &= np.where(span > 0, eff / np.maximum(span, 1), -np.inf) >= params.min_nscore
     if params.min_ncscore is not None:
         keep &= ncscore >= params.min_ncscore
     if not keep.any():

@@ -78,7 +78,7 @@ def fused_accumulate(
 
     aln_len = q_end - q_start
     taboo = (jnp.full((R,), taboo_abs, jnp.int32) if taboo_abs
-             else jnp.round(aln_len * taboo_frac).astype(jnp.int32))
+             else jnp.floor(aln_len * taboo_frac + 0.5).astype(jnp.int32))
     kept_lo = q_start + taboo      # first kept query index
     kept_hi = q_end - taboo        # one past last kept
     ok = (
@@ -144,15 +144,14 @@ def fused_accumulate(
     is_i = is_i & in_bounds
     is_m = is_m & in_bounds
 
-    # insertion run structure: forward order is reversed step order, so the
-    # forward-run offset comes from a reverse-direction scan
-    def fwd(carry, x):
-        cur = jnp.where(x, carry, 0)
-        return cur + x.astype(jnp.int32), cur
-
-    _, ins_off_t = jax.lax.scan(fwd, jnp.zeros(R, jnp.int32),
-                                is_i.T[::-1])
-    ins_off = ins_off_t[::-1].T                      # [R, T]
+    # insertion run structure, closed-form (runs are contiguous in s): the
+    # forward-start of a run is the nearest non-I step at s' > s minus one,
+    # so with M[s] = min{s' >= s : not I} the forward offset is M[s]-1-s.
+    # Log-depth associative cummin instead of a T-step sequential scan.
+    s_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+    non_i_at = jnp.where(is_i, T, s_idx)             # [R, T]
+    M = jax.lax.associative_scan(jnp.minimum, non_i_at, reverse=True, axis=1)
+    ins_off = jnp.maximum(M - 1 - s_idx, 0)          # [R, T]
     # forward run end at step s: I here, forward-next (s-1) is not I
     prev_is_i = jnp.concatenate(
         [jnp.zeros((R, 1), bool), is_i[:, :-1]], axis=1

@@ -1,0 +1,119 @@
+"""Device meshes and sharded correction kernels.
+
+Mesh axes:
+- ``dp`` — data parallel: long reads (batch axis B) and alignment candidates
+  (axis R) shard here. The reference's analog is independent per-chunk jobs
+  (``README.org:59-78``).
+- ``sp`` — sequence parallel: the long-read length axis L of the pileup and
+  consensus tensors shards here, bounding per-chip memory for very long
+  reads (the reference bounds this with 20bp-bin coverage caps instead,
+  ``Sam/Seq.pm:515-517``; we keep those AND shard).
+
+GSPMD inserts the collectives: the candidate->pileup scatter all-to-alls
+over ICI; consensus calling is column-local so ``sp`` needs no comms.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from proovread_tpu.align.params import AlignParams
+from proovread_tpu.align.sw import sw_batch
+from proovread_tpu.ops.consensus_call import ConsensusCall, call_consensus
+from proovread_tpu.ops.fused import fused_accumulate
+from proovread_tpu.ops.pileup import Pileup, init_pileup
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    sp: Optional[int] = None,
+) -> Mesh:
+    """Build a (dp, sp) mesh. ``sp`` defaults to 1 (pure data parallel) —
+    raise it for very long reads where the [B, L, S] pileup must shard over
+    length."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    sp = sp or 1
+    if n % sp:
+        raise ValueError(f"{n} devices not divisible by sp={sp}")
+    arr = np.array(devs).reshape(n // sp, sp)
+    return Mesh(arr, ("dp", "sp"))
+
+
+def shard_batch(mesh: Mesh, codes: np.ndarray, qual: np.ndarray,
+                lengths: np.ndarray):
+    """Place a packed read batch with B sharded over dp and L over sp."""
+    s2 = NamedSharding(mesh, P("dp", "sp"))
+    s1 = NamedSharding(mesh, P("dp"))
+    return (jax.device_put(codes, s2), jax.device_put(qual, s2),
+            jax.device_put(lengths, s1))
+
+
+def sharded_call_consensus(mesh: Mesh, pile: Pileup, ref_codes,
+                           max_ins_length: int = 0) -> ConsensusCall:
+    """Consensus call with [B, L, ...] tensors sharded (dp, sp)."""
+    s = NamedSharding(mesh, P("dp", "sp"))
+    pile = Pileup(*(jax.device_put(t, NamedSharding(mesh, P("dp", "sp", *([None] * (t.ndim - 2)))))
+                    for t in pile))
+    ref_codes = jax.device_put(ref_codes, s)
+    return call_consensus(pile, ref_codes, max_ins_length)
+
+
+def sharded_correction_step(mesh: Mesh, params: AlignParams,
+                            qual_weighted: bool = False,
+                            min_aln_length: int = 50):
+    """Build the jitted full correction step over the mesh: SW extension of a
+    candidate chunk + fused pileup scatter + consensus call, with candidates
+    sharded over dp and pileup tensors sharded (dp, sp).
+
+    Returns ``step(pile, lr_codes, q, r_win, qlen, qual, read_idx, win_start,
+    admitted) -> (Pileup, ConsensusCall, scores)``. This is the multi-chip
+    "training step" analog the driver dry-runs.
+    """
+    cand = NamedSharding(mesh, P("dp"))            # candidate axis
+    cand2 = NamedSharding(mesh, P("dp", None))
+    bl = NamedSharding(mesh, P("dp", "sp"))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(pile, lr_codes, q, r_win, qlen, qual, read_idx, win_start,
+             admitted):
+        res = sw_batch(q, r_win, qlen, params)
+        if params.score_per_base:
+            thr = params.min_out_score * qlen.astype(jnp.float32)
+        else:
+            thr = jnp.full(qlen.shape, params.min_out_score, jnp.float32)
+        adm = admitted & (res.score >= thr)
+        pile = fused_accumulate(
+            pile, res.ops_rev, res.step_i, res.step_j, q, qual,
+            res.q_start, res.q_end, read_idx, win_start, adm,
+            qual_weighted=qual_weighted, min_aln_length=min_aln_length,
+        )
+        call = call_consensus(pile, lr_codes, 0)
+        return pile, call, res.score
+
+    def run(pile, lr_codes, q, r_win, qlen, qual, read_idx, win_start,
+            admitted):
+        pile = Pileup(*(jax.device_put(
+            t, NamedSharding(mesh, P("dp", "sp", *([None] * (t.ndim - 2)))))
+            for t in pile))
+        lr_codes = jax.device_put(lr_codes, bl)
+        q = jax.device_put(q, cand2)
+        r_win = jax.device_put(r_win, cand2)
+        qual = jax.device_put(qual, cand2)
+        qlen = jax.device_put(qlen, cand)
+        read_idx = jax.device_put(read_idx, cand)
+        win_start = jax.device_put(win_start, cand)
+        admitted = jax.device_put(admitted, cand)
+        return step(pile, lr_codes, q, r_win, qlen, qual, read_idx,
+                    win_start, admitted)
+
+    return run

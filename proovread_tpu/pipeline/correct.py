@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from proovread_tpu.align import seed as seed_mod
@@ -80,9 +81,10 @@ class FastCorrector:
                     [refs.codes, np.full((B, n - L), 4, np.int8)], axis=1),
                 n, axis=1)
 
-        # pass 1: SW all chunks, keep traceback tensors on device
+        # pass 1: SW all chunks, keep traceback tensors on device; fetch the
+        # small per-candidate stats in ONE device->host transfer at the end
+        # (each fetch is a round trip through the device tunnel)
         chunks = []
-        scores, q_starts, q_ends, r_starts, r_ends = [], [], [], [], []
         C = self.chunk_rows
         for start in range(0, max(n_cand, 1), C):
             sl = slice(start, min(start + C, n_cand))
@@ -99,18 +101,21 @@ class FastCorrector:
             ql[:R] = queries.lengths[cand.sread[sl]]
             res = sw_batch(jnp.asarray(qc), jnp.asarray(rcw), jnp.asarray(ql), p)
             chunks.append((sl, res, qc, ql))
-            scores.append(np.asarray(res.score)[:R])
-            q_starts.append(np.asarray(res.q_start)[:R])
-            q_ends.append(np.asarray(res.q_end)[:R])
-            r_starts.append(np.asarray(res.r_start)[:R])
-            r_ends.append(np.asarray(res.r_end)[:R])
 
         if chunks:
-            score = np.concatenate(scores)
-            q_start = np.concatenate(q_starts)
-            q_end = np.concatenate(q_ends)
-            r_start = np.concatenate(r_starts)
-            r_end = np.concatenate(r_ends)
+            stats5 = jax.device_get(jnp.stack([
+                jnp.concatenate([c[1].score for c in chunks]),
+                jnp.concatenate([c[1].q_start.astype(jnp.float32) for c in chunks]),
+                jnp.concatenate([c[1].q_end.astype(jnp.float32) for c in chunks]),
+                jnp.concatenate([c[1].r_start.astype(jnp.float32) for c in chunks]),
+                jnp.concatenate([c[1].r_end.astype(jnp.float32) for c in chunks]),
+            ]))
+            nc = n_cand
+            score = stats5[0, :nc]
+            q_start = stats5[1, :nc].astype(np.int32)
+            q_end = stats5[2, :nc].astype(np.int32)
+            r_start = stats5[3, :nc].astype(np.int32)
+            r_end = stats5[4, :nc].astype(np.int32)
 
             if p.score_per_base:
                 thr = p.min_out_score * queries.lengths[cand.sread]
@@ -153,8 +158,8 @@ class FastCorrector:
                 jnp.asarray(adm),
                 ignore_mask=ignore,
                 qual_weighted=cns.qual_weighted,
-                taboo_frac=cns.indel_taboo,
-                taboo_abs=cns.indel_taboo_length or 0,
+                taboo_frac=cns.indel_taboo if cns.trim else 0.0,
+                taboo_abs=(cns.indel_taboo_length or 0) if cns.trim else 0,
                 min_aln_length=cns.min_aln_length,
             )
 

@@ -1,10 +1,19 @@
-"""Test config: force an 8-device virtual CPU platform before jax imports so
-sharding tests exercise real multi-device code paths without TPU hardware."""
+"""Test config: force an 8-device virtual CPU platform before any backend
+initialization so sharding tests exercise real multi-device code paths
+without TPU hardware.
+
+NB: in the axon environment the JAX_PLATFORMS env var is overridden by the
+plugin — only ``jax.config.update("jax_platforms", ...)`` reliably selects
+the CPU backend, so both are set here."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

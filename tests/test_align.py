@@ -211,6 +211,25 @@ class TestSeeding:
         rev = cand.strand == 1
         assert rev.any()
 
+    def test_deep_batch_position_decoding(self):
+        """Regression: index positions must use stride L, not L-k+1 — reads
+        deep in the batch drifted by k-1 per row and lost their seeds."""
+        rng = np.random.default_rng(11)
+        B = 60
+        reads = [decode_codes(rng.integers(0, 4, 500).astype(np.int8))
+                 for _ in range(B)]
+        lr = pack_reads([SeqRecord(f"lr{i}", s) for i, s in enumerate(reads)])
+        idx = seed_mod.build_index(lr.codes, lr.lengths, 12)
+        # query an exact 100bp slice of the LAST read
+        q = reads[B - 1][300:400]
+        sr = pack_reads([SeqRecord("q", q)])
+        cand = seed_mod.find_candidates(idx, sr.codes, sr.lengths, P)
+        fwd = (cand.strand == 0) & (cand.lread == B - 1)
+        assert fwd.any(), "true hit on last read lost"
+        best = np.argmax(np.where(fwd, cand.votes, -1))
+        assert abs(int(cand.diag[best]) - 300) < 5
+        assert int(cand.votes[best]) > 50
+
     def test_masked_regions_attract_no_seeds(self):
         rng = np.random.default_rng(3)
         genome = rng.integers(0, 4, 1000).astype(np.int8)
