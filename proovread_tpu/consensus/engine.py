@@ -233,22 +233,11 @@ class ConsensusEngine:
         res: ConsensusResult,
     ) -> List[Tuple[int, int, float]]:
         p = self.params
-        bin_bases = aset.bin_bases
-        if bin_bases is None or len(bin_bases) <= 20:
+        bb = aset.bin_bases
+        if bb is None or len(bb) <= 20:
             return []
-        thr = p.bin_max_bases / 5 + 1
-
-        # runs of 1-4 consecutive low-coverage bins, skipping 5 terminal bins
-        runs = []
-        lcov = 0
-        for i in range(5, len(bin_bases) - 5):
-            if bin_bases[i] <= thr:
-                lcov += 1
-            elif lcov:
-                if 1 <= lcov < 5:
-                    runs.append((i - lcov, i - 1))
-                lcov = 0
-        if not runs:
+        # cheap prescreen before the O(total aligned bases) cover build
+        if not (np.asarray(bb)[5:-5] <= p.bin_max_bases / 5 + 1).any():
             return []
 
         # plain full coverage for the covered-window check (chimera recomputes
@@ -258,85 +247,14 @@ class ConsensusEngine:
             a, b = max(0, cs.rpos), min(L, cs.rpos + cs.span)
             cover[a:b] += 1
 
-        # project ref coords -> corrected coords: corrected = #bases emitted
-        # before the column (equivalent to the reference's -I,+D cigar walk)
-        emit_counts_prefix = None
-
-        out = []
-        bs = p.bin_size
         aln_bins = aset.aln_bins
-        for (r0, r1) in runs:
-            mat_from = (r0 - 1) * bs
-            mat_to = (r1 + 2) * bs - 1
-            if mat_from < 0 or mat_to >= L:
-                continue
-            if np.any(cover[mat_from : mat_to + 1] == 0):
-                continue
-            fl, tr = r0 - 4, r1 + 5
-            delta = (tr - fl - 1) // 2
-            tl, fr = fl + delta, tr - delta
 
+        def select(fl, tl, fr, tr):
             sel_l = [cs for cs, j in expanded if fl <= aln_bins[j] <= tl]
             sel_r = [cs for cs, j in expanded if fr <= aln_bins[j] <= tr]
-            Wn = mat_to + 1 - mat_from
-            cl = self._window_counts(sel_l, mat_from, Wn)
-            cr = self._window_counts(sel_r, mat_from, Wn)
+            return sel_l, sel_r
 
-            hx_delta = []
-            for c in range(Wn):
-                l, r = cl[c], cr[c]
-                if l.sum() == 0 or r.sum() == 0:
-                    continue
-                comb = l + r
-                hx_delta.append(_hx(comb) - max(_hx(l), _hx(r)))
-            if not hx_delta:
-                continue
-            score = float(np.mean(np.array(hx_delta) > 0.7))
-            f, t = mat_from + bs, mat_to - bs
-            if emit_counts_prefix is None:
-                emit_counts_prefix = self._emit_prefix(res, L)
-            out.append((int(emit_counts_prefix[f]), int(emit_counts_prefix[t]), score))
-        return out
-
-    def _window_counts(self, sel: Sequence[ColumnStates], mat_from: int, Wn: int) -> np.ndarray:
-        """[Wn, S+1] plain state counts + merged-insertion pseudo-state."""
-        counts = np.zeros((Wn, N_STATES + 1), np.float64)
-        for cs in sel:
-            lo = max(cs.rpos, mat_from)
-            hi = min(cs.rpos + cs.span, mat_from + Wn)
-            if lo >= hi:
-                continue
-            w0, w1 = lo - cs.rpos, hi - cs.rpos
-            cols = np.arange(lo - mat_from, hi - mat_from)
-            st = cs.state[w0:w1].astype(np.int64)
-            has_ins = cs.ins_len[w0:w1] > 0
-            np.add.at(counts, (cols[~has_ins], st[~has_ins]), 1.0)
-            np.add.at(counts, (cols[has_ins], np.full(has_ins.sum(), N_STATES)), 1.0)
-        return counts
-
-    def _emit_prefix(self, res: ConsensusResult, L: int) -> np.ndarray:
-        """corrected-coordinate of each reference column (prefix sum of
-        emitted base counts), recovered from the consensus cigar."""
-        emit = np.zeros(L + 1, np.int64)
-        col = 0
-        import re as _re
-
-        pos_corr = 0
-        for m in _re.finditer(r"(\d+)([MID])", res.cigar):
-            ln, op = int(m.group(1)), m.group(2)
-            if op == "M":
-                for _ in range(ln):
-                    emit[col] = pos_corr
-                    pos_corr += 1
-                    col += 1
-            elif op == "I":
-                for _ in range(ln):
-                    emit[col] = pos_corr
-                    col += 1
-            else:  # D: extra consensus bases, no ref column consumed
-                pos_corr += ln
-        emit[col:] = pos_corr
-        return emit
+        return chimera_scan(aset.bin_bases, L, p, res, cover, select)
 
 
 def assemble_consensus(
@@ -387,6 +305,108 @@ def assemble_consensus(
         coverage=coverage,
         cigar="".join(cigar_parts),
     )
+
+
+def chimera_scan(bin_bases, L, params, res, cover, select) -> List[Tuple[int, int, float]]:
+    """Shared chimera core (Sam/Seq.pm:774-888): low-fill bin runs ->
+    left/right flanking state matrices -> per-column entropy delta.
+
+    ``select(fl, tl, fr, tr)`` returns (left, right) lists of
+    :class:`ColumnStates` for alignments whose bin falls in those ranges —
+    host-expanded by the engine, lazily expanded by the fused path."""
+    p = params
+    if bin_bases is None or len(bin_bases) <= 20:
+        return []
+    thr = p.bin_max_bases / 5 + 1
+
+    # runs of 1-4 consecutive low-coverage bins, skipping 5 terminal bins
+    runs = []
+    lcov = 0
+    for i in range(5, len(bin_bases) - 5):
+        if bin_bases[i] <= thr:
+            lcov += 1
+        else:
+            if 1 <= lcov < 5:
+                runs.append((i - lcov, i - 1))
+            lcov = 0
+    if not runs:
+        return []
+
+    emit_counts_prefix = None
+    out = []
+    bs = p.bin_size
+    for (r0, r1) in runs:
+        mat_from = (r0 - 1) * bs
+        mat_to = (r1 + 2) * bs - 1
+        if mat_from < 0 or mat_to >= L:
+            continue
+        if np.any(cover[mat_from: mat_to + 1] == 0):
+            continue
+        fl, tr = r0 - 4, r1 + 5
+        delta = (tr - fl - 1) // 2
+        tl, fr = fl + delta, tr - delta
+
+        sel_l, sel_r = select(fl, tl, fr, tr)
+        Wn = mat_to + 1 - mat_from
+        cl = window_counts(sel_l, mat_from, Wn)
+        cr = window_counts(sel_r, mat_from, Wn)
+
+        hx_delta = []
+        for c in range(Wn):
+            lcol, rcol = cl[c], cr[c]
+            if lcol.sum() == 0 or rcol.sum() == 0:
+                continue
+            hx_delta.append(_hx(lcol + rcol) - max(_hx(lcol), _hx(rcol)))
+        if not hx_delta:
+            continue
+        score = float(np.mean(np.array(hx_delta) > 0.7))
+        f, t = mat_from + bs, mat_to - bs
+        if emit_counts_prefix is None:
+            emit_counts_prefix = emit_prefix(res, L)
+        out.append((int(emit_counts_prefix[f]), int(emit_counts_prefix[t]), score))
+    return out
+
+
+def window_counts(sel: Sequence[ColumnStates], mat_from: int, Wn: int) -> np.ndarray:
+    """[Wn, S+1] plain state counts + merged-insertion pseudo-state."""
+    counts = np.zeros((Wn, N_STATES + 1), np.float64)
+    for cs in sel:
+        lo = max(cs.rpos, mat_from)
+        hi = min(cs.rpos + cs.span, mat_from + Wn)
+        if lo >= hi:
+            continue
+        w0, w1 = lo - cs.rpos, hi - cs.rpos
+        cols = np.arange(lo - mat_from, hi - mat_from)
+        st = cs.state[w0:w1].astype(np.int64)
+        has_ins = cs.ins_len[w0:w1] > 0
+        np.add.at(counts, (cols[~has_ins], st[~has_ins]), 1.0)
+        np.add.at(counts, (cols[has_ins], np.full(has_ins.sum(), N_STATES)), 1.0)
+    return counts
+
+
+def emit_prefix(res: ConsensusResult, L: int) -> np.ndarray:
+    """corrected-coordinate of each reference column (prefix sum of emitted
+    base counts), recovered from the consensus cigar."""
+    import re as _re
+
+    emit = np.zeros(L + 1, np.int64)
+    col = 0
+    pos_corr = 0
+    for m in _re.finditer(r"(\d+)([MID])", res.cigar):
+        ln, op = int(m.group(1)), m.group(2)
+        if op == "M":
+            for _ in range(ln):
+                emit[col] = pos_corr
+                pos_corr += 1
+                col += 1
+        elif op == "I":
+            for _ in range(ln):
+                emit[col] = pos_corr
+                col += 1
+        else:  # D: extra consensus bases, no ref column consumed
+            pos_corr += ln
+    emit[col:] = pos_corr
+    return emit
 
 
 def _hx(col: np.ndarray) -> float:

@@ -1,6 +1,17 @@
-"""Pipeline layer: the fast fused correction step and (M3) the iterative
-masking driver replacing ``bin/proovread``'s task state machine."""
+"""Pipeline layer: the fast fused correction step and the iterative masking
+driver replacing ``bin/proovread``'s task state machine."""
 
 from proovread_tpu.pipeline.correct import FastCorrector, CorrectionStats
+from proovread_tpu.pipeline.driver import (
+    Pipeline, PipelineConfig, PipelineResult, TaskReport,
+)
+from proovread_tpu.pipeline.masking import MaskParams, hcr_intervals, mask_batch
+from proovread_tpu.pipeline.sampling import CoverageSampler
+from proovread_tpu.pipeline.trim import TrimParams, trim_records
 
-__all__ = ["FastCorrector", "CorrectionStats"]
+__all__ = [
+    "FastCorrector", "CorrectionStats",
+    "Pipeline", "PipelineConfig", "PipelineResult", "TaskReport",
+    "MaskParams", "hcr_intervals", "mask_batch",
+    "CoverageSampler", "TrimParams", "trim_records",
+]

@@ -57,13 +57,27 @@ class FastCorrector:
         refs: ReadBatch,
         queries: ReadBatch,
         ignore_coords: Optional[Sequence[Sequence[Tuple[int, int]]]] = None,
+        mask_codes: Optional[np.ndarray] = None,
+        detect_chimera: bool = False,
     ) -> Tuple[List[ConsensusResult], CorrectionStats]:
+        """Correct one batch.
+
+        ``mask_codes``: N-masked copy of ``refs.codes`` used as the *mapping*
+        target (seeding + SW windows), while consensus ref votes use the
+        unmasked reads — the reference maps against the masked FASTA but
+        hands bam2cns the unmasked FASTQ (``bin/proovread:837-851,1547``).
+        ``ignore_coords``: MCR intervals whose columns take no SR votes.
+        ``detect_chimera``: run the low-coverage-bin entropy scan (finish
+        tasks, ``bin/bam2cns:461-491``); results land on each
+        ``ConsensusResult.chimera``.
+        """
         p = self.align_params
         cns = self.cns_params
         B, L = refs.codes.shape
+        map_codes = mask_codes if mask_codes is not None else refs.codes
 
         rc_codes = seed_mod.revcomp_batch(queries.codes, queries.lengths)
-        index = seed_mod.build_index(refs.codes, refs.lengths, p.min_seed_len)
+        index = seed_mod.build_index(map_codes, refs.lengths, p.min_seed_len)
         cand = seed_mod.find_candidates(
             index, queries.codes, queries.lengths, p, rc=rc_codes
         )
@@ -74,11 +88,11 @@ class FastCorrector:
         win_start = np.clip(cand.diag - p.band_width, 0, max(0, L - n))
         if L >= n:
             ref_windows = np.lib.stride_tricks.sliding_window_view(
-                refs.codes, n, axis=1)
+                map_codes, n, axis=1)
         else:
             ref_windows = np.lib.stride_tricks.sliding_window_view(
                 np.concatenate(
-                    [refs.codes, np.full((B, n - L), 4, np.int8)], axis=1),
+                    [map_codes, np.full((B, n - L), 4, np.int8)], axis=1),
                 n, axis=1)
 
         # pass 1: SW all chunks, keep traceback tensors on device; fetch the
@@ -188,7 +202,80 @@ class FastCorrector:
                 ins_bases[i, :nn], freq[i, :nn], phred[i, :nn],
                 coverage[i, :nn],
             ))
+
+        if detect_chimera and chunks and admitted.any():
+            self._detect_chimera(
+                results, refs, queries, cand, chunks, admitted,
+                win_start, r_start, r_end, q_start, q_end, score)
+
         return results, CorrectionStats(n_cand, int(admitted.sum()))
+
+    def _detect_chimera(self, results, refs, queries, cand, chunks, admitted,
+                        win_start, r_start, r_end, q_start, q_end, score):
+        """Lazy chimera scan: bin stats from the admitted-candidate arrays;
+        only alignments flanking a suspicious low-fill bin run are fetched
+        from the device chunks and expanded on host."""
+        from proovread_tpu.align.sw import ops_to_cigar
+        from proovread_tpu.consensus.cigar import expand_alignment
+        from proovread_tpu.consensus.engine import chimera_scan
+
+        cns = self.cns_params
+        bs = cns.bin_size
+        C = self.chunk_rows
+        span = r_end - r_start
+        pos0 = win_start + r_start
+        bins = np.clip(((pos0 + 1 + span / 2) // bs).astype(np.int64),
+                       0, None)
+        adm_idx = np.flatnonzero(admitted)
+        ops_cache = {}
+
+        def fetch_cols(ci):
+            """ColumnStates of candidate ci (host-expanded, cached)."""
+            if ci in ops_cache:
+                return ops_cache[ci]
+            ck = ci // C
+            row = ci % C
+            sl, res, qc, ql = chunks[ck]
+            ops_rev = np.asarray(res.ops_rev[row])
+            n_ops = int((ops_rev != 3).sum())
+            ops, lens = ops_to_cigar(
+                ops_rev, n_ops, int(q_start[ci]), int(q_end[ci]),
+                int(ql[row]))
+            si = int(cand.sread[ci])
+            qual = queries.qual[si, :int(ql[row])]
+            if cand.strand[ci]:
+                qual = qual[::-1]
+            cs = expand_alignment(
+                int(pos0[ci]), ops, lens, qc[row, :int(ql[row])], qual, cns)
+            ops_cache[ci] = cs
+            return cs
+
+        for b in range(refs.codes.shape[0]):
+            L_i = int(refs.lengths[b])
+            mine = adm_idx[cand.lread[adm_idx] == b]
+            if mine.size == 0:
+                continue
+            n_bins = L_i // bs + 1
+            bb = np.bincount(np.clip(bins[mine], 0, n_bins - 1),
+                             weights=span[mine].astype(np.float64),
+                             minlength=n_bins)
+            if n_bins <= 20 or not (bb[5:-5] <= cns.bin_max_bases / 5 + 1).any():
+                continue
+            cover = np.zeros(L_i)
+            for ci in mine:
+                a, e = max(0, int(pos0[ci])), min(L_i, int(pos0[ci] + span[ci]))
+                cover[a:e] += 1
+
+            def select(fl, tl, fr, tr, mine=mine, b=b):
+                sel_l = [fetch_cols(ci) for ci in mine
+                         if fl <= bins[ci] <= tl]
+                sel_r = [fetch_cols(ci) for ci in mine
+                         if fr <= bins[ci] <= tr]
+                return ([c for c in sel_l if c is not None],
+                        [c for c in sel_r if c is not None])
+
+            results[b].chimera = chimera_scan(
+                bb, L_i, cns, results[b], cover, select)
 
 
 def _reverse_quals(qual: np.ndarray, lengths: np.ndarray) -> np.ndarray:

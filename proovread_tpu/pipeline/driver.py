@@ -1,0 +1,254 @@
+"""The iterative correction pipeline — ``bin/proovread``'s task state machine
+rebuilt around the fused device corrector.
+
+Task flow per mode (``proovread.cfg:105-142``): ``read-long`` (input
+normalization + stubby filter), then iterated ``bwa-{sr,mr}-N`` mapping +
+consensus passes against a progressively masked reference, with the
+mask-shortcut (skip to finish when masked% > 92% or gain < 3%,
+``bin/proovread:2026-2047``), and a ``*-finish`` pass against the unmasked
+reads with strict parameters, chimera detection and no ref-qual recycling
+(``bin/proovread:1573-1579``). Output: untrimmed corrected records plus the
+trimmed/split records of ``trim.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from proovread_tpu.align.params import AlignParams, BWA_SR, BWA_SR_FINISH, BWA_MR, BWA_MR_1, BWA_MR_FINISH
+from proovread_tpu.consensus.engine import ConsensusResult
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.batch import ReadBatch, pack_reads
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops.encode import encode_ascii
+from proovread_tpu.pipeline.correct import FastCorrector
+from proovread_tpu.pipeline.masking import MaskParams, mask_batch
+from proovread_tpu.pipeline.sampling import CoverageSampler
+from proovread_tpu.pipeline.trim import TrimParams, trim_records
+
+log = logging.getLogger("proovread_tpu")
+
+
+@dataclass
+class PipelineConfig:
+    mode: str = "sr"                  # sr | mr (| *-noccs; ccs task pending)
+    n_iterations: int = 6             # bwa-sr-1..6 before finish
+    sr_coverage: float = 15.0         # per-iteration sampling target
+    finish_coverage: float = 30.0     # sr-coverage for *-finish
+    coverage: Optional[float] = None  # input SR coverage (estimated if None)
+    mask_shortcut_frac: float = 0.92  # proovread.cfg:246-249
+    mask_min_gain_frac: float = 0.03
+    hcr_mask: MaskParams = field(default_factory=MaskParams)
+    hcr_mask_late: MaskParams = field(
+        default_factory=lambda: MaskParams(end_ratio=0.3))  # tasks 4-6
+    lr_min_length: Optional[int] = None  # default 2 * sr_len (stubby filter)
+    sampling: bool = True
+    trim: TrimParams = field(default_factory=TrimParams)
+    batch_reads: int = 128            # long reads per device batch
+    indel_taboo_length: int = 7       # sr-indel-taboo-length
+    coverage_scale: float = 0.75      # coverage-scale-factor (proovread.cfg:256)
+
+
+@dataclass
+class TaskReport:
+    task: str
+    masked_frac: float
+    n_candidates: int
+    n_admitted: int
+
+
+@dataclass
+class PipelineResult:
+    untrimmed: List[SeqRecord]
+    trimmed: List[SeqRecord]
+    ignored: List[Tuple[str, str]]            # (read id, reason)
+    chimera: List[Tuple[str, int, int, float]]
+    reports: List[TaskReport] = field(default_factory=list)
+
+
+def _align_params(mode: str, iteration: Optional[int]) -> AlignParams:
+    """Task schedule resolution (cfg task-counter suffix semantics,
+    bin/proovread:1989-2024): iteration None = finish."""
+    if mode.startswith("sr"):
+        return BWA_SR_FINISH if iteration is None else BWA_SR
+    if iteration is None:
+        return BWA_MR_FINISH
+    return BWA_MR_1 if iteration == 1 else BWA_MR
+
+
+class Pipeline:
+    def __init__(self, config: Optional[PipelineConfig] = None):
+        self.config = config or PipelineConfig()
+
+    # -- read-long (bin/proovread:1368-1520) ------------------------------
+    def read_long(self, records: Sequence[SeqRecord], min_sr_len: int
+                  ) -> Tuple[List[SeqRecord], List[Tuple[str, str]]]:
+        cfg = self.config
+        # defined-or, not falsy-or: lr_min_length=0 disables the filter
+        # (reference: cfg('lr-min-length') // 2*$min_sr_length)
+        stubby = (cfg.lr_min_length if cfg.lr_min_length is not None
+                  else 2 * min_sr_len)
+        kept, ignored = [], []
+        seen = set()
+        for r in records:
+            if r.id in seen:
+                raise ValueError(f"duplicate long-read id {r.id!r}")
+            seen.add(r.id)
+            if len(r) < stubby:
+                ignored.append((r.id, "too short"))
+                continue
+            kept.append(r)
+        kept.sort(key=lambda r: r.id)  # natural-sorted output order
+        return kept, ignored
+
+    # -- main -------------------------------------------------------------
+    def run(self, long_records: Sequence[SeqRecord],
+            short_records: Sequence[SeqRecord]) -> PipelineResult:
+        cfg = self.config
+        sr_lens = np.array([len(r) for r in short_records])
+        min_sr_len = int(np.median(sr_lens)) if len(sr_lens) else 100
+
+        kept, ignored = self.read_long(long_records, min_sr_len)
+        reports: List[TaskReport] = []
+        all_chim: List[Tuple[str, int, int, float]] = []
+
+        if not kept:
+            return PipelineResult([], [], ignored, [], reports)
+
+        total_lr = sum(len(r) for r in kept)
+        coverage = cfg.coverage
+        if coverage is None:
+            coverage = sum(len(r) for r in short_records) / max(total_lr, 1)
+
+        sampler = CoverageSampler()
+        sr_all = pack_reads(short_records)
+
+        untrimmed: List[SeqRecord] = []
+        results_final: List[ConsensusResult] = []
+
+        for start in range(0, len(kept), cfg.batch_reads):
+            batch_recs = kept[start:start + cfg.batch_reads]
+            res_batch, chim = self._run_batch(
+                batch_recs, sr_all, short_records, sampler, coverage,
+                min_sr_len, reports)
+            results_final.extend(res_batch)
+            all_chim.extend(chim)
+            untrimmed.extend(r.record for r in res_batch)
+
+        trimmed = trim_records(results_final, cfg.trim)
+        return PipelineResult(untrimmed, trimmed, ignored, all_chim, reports)
+
+    def _run_batch(self, batch_recs, sr_all, short_records, sampler,
+                   coverage, min_sr_len, reports):
+        cfg = self.config
+        lr = pack_reads(batch_recs)
+        B, L = lr.codes.shape
+
+        cur_codes = lr.codes.copy()
+        cur_quals: List[np.ndarray] = [lr.qual[i] for i in range(B)]
+        cur_lengths = lr.lengths.copy()
+        cur_ids = list(lr.ids)
+        mask_codes = None
+        mcrs: Optional[List[List[Tuple[int, int]]]] = None
+        # seed so the min-gain shortcut can never fire on iteration 1
+        # (reference: $masked_prev = -$masked_gain, bin/proovread:2026-2047)
+        masked_frac = -cfg.mask_min_gain_frac
+
+        max_cov = max(int(min(coverage, cfg.sr_coverage) * cfg.coverage_scale + 0.5), 1)
+
+        it = 1
+        while it <= cfg.n_iterations:
+            task = f"bwa-{cfg.mode[:2]}-{it}"
+            ap = _align_params(cfg.mode, it)
+            # qual-weighted voting is a utg-task knob only; sr/mr iterations
+            # vote uniformly but recycle ref quals (bin/proovread:1573-1589)
+            cns = ConsensusParams(
+                qual_weighted=False, use_ref_qual=True,
+                indel_taboo_length=cfg.indel_taboo_length,
+                max_coverage=max_cov,
+            )
+            fc = FastCorrector(align_params=ap, cns_params=cns)
+
+            sel = sampler.select(len(short_records), coverage,
+                                 cfg.sr_coverage) if cfg.sampling else \
+                np.arange(len(short_records))
+            sr = _take_batch(sr_all, sel)
+
+            cur_batch = ReadBatch(ids=cur_ids, codes=cur_codes,
+                                  qual=_stack_quals(cur_quals, L),
+                                  lengths=cur_lengths)
+            out, stats = fc.correct_batch(
+                cur_batch, sr, ignore_coords=mcrs, mask_codes=mask_codes)
+
+            # next iteration state: corrected reads (new coordinates!)
+            cur_recs = [o.record for o in out]
+            nb = pack_reads(cur_recs, pad_len=None)
+            cur_codes = nb.codes
+            cur_lengths = nb.lengths
+            cur_ids = list(nb.ids)
+            cur_quals = [nb.qual[i] for i in range(nb.batch_size)]
+            L = nb.pad_len
+
+            mp = (cfg.hcr_mask if it < 4 else cfg.hcr_mask_late).scaled(min_sr_len)
+            mask_codes, mcrs, new_frac = mask_batch(
+                cur_codes, cur_quals, cur_lengths, mp)
+            gain = new_frac - masked_frac
+            masked_frac = new_frac
+            reports.append(TaskReport(task, masked_frac, stats.n_candidates,
+                                      stats.n_admitted))
+            log.info("%s: masked %.1f%%", task, masked_frac * 100)
+
+            it += 1
+            if it <= cfg.n_iterations and (
+                    masked_frac > cfg.mask_shortcut_frac
+                    or gain < cfg.mask_min_gain_frac):
+                log.info("mask shortcut: skipping to finish "
+                         "(masked %.3f, gain %.3f)", masked_frac, gain)
+                break
+
+        # finish: strict params, UNMASKED ref, no ref-qual recycling, no MCR,
+        # chimera detection (bin/proovread:1573-1579)
+        ap = _align_params(cfg.mode, None)
+        cns = ConsensusParams(
+            qual_weighted=False, use_ref_qual=False,
+            indel_taboo_length=cfg.indel_taboo_length,
+            max_coverage=max(int(min(coverage, cfg.finish_coverage)
+                                 * cfg.coverage_scale + 0.5), 1),
+        )
+        fc = FastCorrector(align_params=ap, cns_params=cns)
+        sel = sampler.select(len(short_records), coverage,
+                             cfg.finish_coverage) if cfg.sampling else \
+            np.arange(len(short_records))
+        sr = _take_batch(sr_all, sel)
+        cur_batch = ReadBatch(ids=cur_ids, codes=cur_codes,
+                              qual=_stack_quals(cur_quals, L),
+                              lengths=cur_lengths)
+        out, stats = fc.correct_batch(cur_batch, sr, detect_chimera=True)
+        frac_phred0 = float(np.mean([o.masked_frac for o in out])) if out else 0.0
+        reports.append(TaskReport(f"bwa-{cfg.mode[:2]}-finish",
+                                  1.0 - frac_phred0,
+                                  stats.n_candidates, stats.n_admitted))
+        log.info("finish: supported %.1f%%", (1.0 - frac_phred0) * 100)
+
+        chim = [(o.record.id, f, t, s) for o in out for (f, t, s) in o.chimera]
+        return out, chim
+
+
+def _take_batch(batch: ReadBatch, idx: np.ndarray) -> ReadBatch:
+    return ReadBatch(
+        ids=[batch.ids[i] for i in idx],
+        codes=batch.codes[idx],
+        qual=batch.qual[idx],
+        lengths=batch.lengths[idx],
+    )
+
+
+def _stack_quals(quals: List[np.ndarray], L: int) -> np.ndarray:
+    out = np.zeros((len(quals), L), np.uint8)
+    for i, q in enumerate(quals):
+        out[i, :len(q)] = q[:L]
+    return out

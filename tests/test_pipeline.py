@@ -1,0 +1,225 @@
+"""Pipeline tests: masking semantics, sampling, trimming, and the full
+iterative driver on a synthetic dataset."""
+
+import numpy as np
+import pytest
+
+from proovread_tpu.io.batch import pack_reads
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
+from proovread_tpu.pipeline import (
+    CoverageSampler, MaskParams, Pipeline, PipelineConfig, TrimParams,
+    hcr_intervals, mask_batch,
+)
+from proovread_tpu.pipeline.trim import split_chimera, trim_window
+
+
+class TestMasking:
+    P = MaskParams(phred_min=20, phred_max=41, mask_min_len=40,
+                   unmask_min_len=60, mask_reduce=10, end_ratio=0.5)
+
+    def test_basic_run_detection(self):
+        q = np.zeros(300, np.uint8)
+        q[100:200] = 30          # one 100bp HCR
+        iv = hcr_intervals(q, 300, self.P)
+        # reduced by 10 on both interior sides
+        assert iv == [(110, 80)]
+
+    def test_short_runs_dropped(self):
+        q = np.zeros(300, np.uint8)
+        q[100:130] = 30          # 30 < mask_min_len 40
+        assert hcr_intervals(q, 300, self.P) == []
+
+    def test_gap_merging(self):
+        q = np.zeros(400, np.uint8)
+        q[50:150] = 30
+        q[180:300] = 30          # 30bp gap < unmask_min_len -> merged
+        iv = hcr_intervals(q, 400, self.P)
+        assert iv == [(60, 230)]
+
+    def test_wide_gap_not_merged(self):
+        q = np.zeros(500, np.uint8)
+        q[50:150] = 30
+        q[300:420] = 30          # 150bp gap >= 60 -> separate
+        iv = hcr_intervals(q, 500, self.P)
+        assert len(iv) == 2
+
+    def test_end_ratio_at_read_ends(self):
+        q = np.zeros(300, np.uint8)
+        q[0:100] = 30            # touches read start
+        iv = hcr_intervals(q, 300, self.P)
+        # start side reduced by 10*0.5=5, interior side by 10
+        assert iv == [(5, 85)]
+
+    def test_phred_range_upper_bound(self):
+        q = np.full(200, 50, np.uint8)   # above phred_max -> not HCR
+        assert hcr_intervals(q, 200, self.P) == []
+
+    def test_mask_batch_frac(self):
+        recs = [SeqRecord("a", "ACGT" * 100, qual=np.zeros(400, np.uint8))]
+        b = pack_reads(recs)
+        quals = [np.zeros(400, np.uint8)]
+        quals[0][100:300] = 30
+        masked, mcrs, frac = mask_batch(b.codes, quals, b.lengths, self.P)
+        assert mcrs[0] == [(110, 180)]
+        assert (masked[0, 110:290] == 4).all()
+        assert (masked[0, :110] != 4).all()
+        assert frac == pytest.approx(180 / 400)
+
+    def test_scaling(self):
+        p = MaskParams(mask_min_len=80, unmask_min_len=130)
+        s = p.scaled(150)
+        assert s.mask_min_len == 120 and s.unmask_min_len == 195
+
+
+class TestSampling:
+    def test_no_sampling_when_cov_close(self):
+        s = CoverageSampler()
+        idx = s.select(1000, coverage=16.0, target=15.0)
+        assert len(idx) == 1000
+
+    def test_sampling_ratio(self):
+        s = CoverageSampler()
+        idx = s.select(100000, coverage=60.0, target=15.0)
+        # 20 * 15/60 = 5 chunks per 20 -> ~25%
+        assert abs(len(idx) / 100000 - 0.25) < 0.02
+
+    def test_rotation_changes_subset(self):
+        s = CoverageSampler()
+        a = s.select(10000, 60.0, 15.0)
+        b = s.select(10000, 60.0, 15.0)
+        assert not np.array_equal(a, b)
+
+    def test_deep_coverage_never_selects_nothing(self):
+        # regression: cps rounded to 0 at very deep coverage -> empty set
+        s = CoverageSampler()
+        idx = s.select(10000, coverage=800.0, target=15.0)
+        assert len(idx) > 0
+
+    def test_mirrors_cov2seqchunker_rotation(self):
+        s = CoverageSampler()
+        firsts = []
+        for _ in range(4):
+            first, cps = s.plan(60.0, 15.0)
+            firsts.append(first)
+            assert cps == 5
+        assert firsts == [1, 6, 11, 16]
+
+
+class TestTrim:
+    def test_window_trim_ends(self):
+        q = np.full(600, 30, np.uint8)
+        q[:20] = 2               # bad head
+        q[-15:] = 2              # bad tail
+        rec = SeqRecord("r", "A" * 600, qual=q)
+        t = trim_window(rec, TrimParams(min_length=100))
+        assert t is not None
+        assert len(t) == 600 - 20 - 15
+
+    def test_min_length_filter(self):
+        rec = SeqRecord("r", "A" * 300, qual=np.full(300, 30, np.uint8))
+        assert trim_window(rec, TrimParams(min_length=500)) is None
+
+    def test_chimera_split(self):
+        rec = SeqRecord("r", "A" * 1000, qual=np.full(1000, 30, np.uint8))
+        parts = split_chimera(rec, [(500, 510, 0.9)], TrimParams())
+        assert len(parts) == 2
+        assert parts[0].id == "r.1" and parts[1].id == "r.2"
+        assert len(parts[0]) == 480      # 500 - trim_len 20
+        assert len(parts[1]) == 1000 - 530
+        assert "SUBSTR:" in parts[0].desc
+
+    def test_chimera_low_score_ignored(self):
+        rec = SeqRecord("r", "A" * 1000, qual=np.full(1000, 30, np.uint8))
+        parts = split_chimera(rec, [(500, 510, 0.1)], TrimParams())
+        assert len(parts) == 1
+
+
+def _make_dataset(rng, G=3000, n_long=4, lr_err=0.13, n_sr=None, sr_err=0.01):
+    genome = rng.integers(0, 4, G).astype(np.int8)
+    longs = []
+    for i in range(n_long):
+        a = int(rng.integers(0, G // 2))
+        b = int(rng.integers(a + 1000, min(a + 2200, G)))
+        src = genome[a:b]
+        noisy = []
+        for base in src:
+            u = rng.random()
+            if u < lr_err * 0.5:
+                noisy.append(int(rng.integers(0, 4)))
+                noisy.append(int(base))
+            elif u < lr_err * 0.75:
+                continue
+            elif u < lr_err:
+                noisy.append(int((base + 1) % 4))
+            else:
+                noisy.append(int(base))
+        longs.append(SeqRecord(f"long_{i}", decode_codes(np.array(noisy, np.int8))))
+    n_sr = n_sr or (40 * G // 100)
+    srs = []
+    for i in range(n_sr):
+        st = int(rng.integers(0, G - 100))
+        seq = genome[st:st + 100].copy()
+        for mu in np.flatnonzero(rng.random(100) < sr_err):
+            seq[mu] = (seq[mu] + 1 + rng.integers(0, 3)) % 4
+        if rng.random() < 0.5:
+            seq = revcomp_codes(seq)
+        srs.append(SeqRecord(f"s{i}", decode_codes(seq),
+                             qual=np.full(100, 30, np.uint8)))
+    return genome, longs, srs
+
+
+class TestPipelineEndToEnd:
+    def test_iterative_correction(self):
+        from proovread_tpu.align.params import AlignParams
+        from proovread_tpu.align.sw import sw_batch
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(7)
+        genome, longs, srs = _make_dataset(rng)
+
+        pipe = Pipeline(PipelineConfig(
+            mode="sr", n_iterations=2, sampling=False,
+            trim=TrimParams(min_length=300)))
+        res = pipe.run(longs, srs)
+
+        assert len(res.untrimmed) == len(longs)
+        assert res.reports, "no task reports"
+        # masked% grows over iterations (reference KPI)
+        fracs = [r.masked_frac for r in res.reports[:-1]]
+        assert fracs[0] > 0.3
+        if len(fracs) > 1:
+            assert fracs[1] >= fracs[0] - 0.05
+
+        loose = AlignParams(clip=0, score_per_base=False, min_out_score=0)
+
+        def ident(codes, ref):
+            pad = ((max(len(codes), len(ref)) + 127) // 128) * 128 + 128
+            qp = np.full(pad, 4, np.int8); qp[:len(codes)] = codes
+            rp = np.full(pad, 4, np.int8); rp[:len(ref)] = ref
+            r = sw_batch(jnp.asarray(qp[None]), jnp.asarray(rp[None]),
+                         jnp.asarray([len(codes)], np.int32), loose)
+            # normalize by the READ length (reads are genome fragments)
+            return float(r.score[0]) / (5 * len(codes))
+
+        # corrected reads align to the genome at high identity
+        idents = [ident(encode_ascii(r.seq), genome) for r in res.untrimmed]
+        assert np.mean(idents) > 0.9, f"mean identity {np.mean(idents):.3f}"
+        # trimmed output exists and is high-quality
+        assert res.trimmed, "no trimmed output"
+
+    def test_stubby_reads_ignored(self):
+        rng = np.random.default_rng(8)
+        genome, longs, srs = _make_dataset(rng, n_long=2)
+        longs.append(SeqRecord("stub", "ACGT" * 10))
+        pipe = Pipeline(PipelineConfig(mode="sr", n_iterations=1,
+                                       sampling=False))
+        res = pipe.run(longs, srs)
+        assert ("stub", "too short") in res.ignored
+        assert len(res.untrimmed) == 2
+
+    def test_duplicate_ids_rejected(self):
+        pipe = Pipeline()
+        recs = [SeqRecord("a", "ACGT" * 100), SeqRecord("a", "ACGT" * 100)]
+        with pytest.raises(ValueError, match="duplicate"):
+            pipe.read_long(recs, 100)
