@@ -53,6 +53,7 @@ class JaxMapper:
         refs: ReadBatch,
         queries: ReadBatch,
         cns_params: Optional[ConsensusParams] = None,
+        candidate_filter=None,
     ) -> MapResult:
         p = self.params
         cns = cns_params or ConsensusParams()
@@ -67,6 +68,9 @@ class JaxMapper:
         cand = seed_mod.find_candidates(
             index, queries.codes, queries.lengths, p, rc=rc_codes
         )
+        if candidate_filter is not None:
+            keep = candidate_filter(cand)
+            cand = seed_mod.Candidates(*(a[keep] for a in cand))
         n_cand = len(cand.sread)
         if n_cand == 0:
             return MapResult(alnsets, 0, 0)

@@ -59,6 +59,7 @@ class FastCorrector:
         ignore_coords: Optional[Sequence[Sequence[Tuple[int, int]]]] = None,
         mask_codes: Optional[np.ndarray] = None,
         detect_chimera: bool = False,
+        candidate_filter=None,
     ) -> Tuple[List[ConsensusResult], CorrectionStats]:
         """Correct one batch.
 
@@ -81,6 +82,9 @@ class FastCorrector:
         cand = seed_mod.find_candidates(
             index, queries.codes, queries.lengths, p, rc=rc_codes
         )
+        if candidate_filter is not None:
+            keep = candidate_filter(cand)
+            cand = seed_mod.Candidates(*(a[keep] for a in cand))
         n_cand = len(cand.sread)
 
         m = queries.pad_len
