@@ -147,9 +147,11 @@ def find_candidates(
     lread = np.concatenate(lrs)
     diag = np.concatenate(dgs)
 
-    dq = (diag + index.length) // quant  # shift positive
+    # shift by the query pad width: diag = rpos - qpos >= -(m-1), and m may
+    # exceed the indexed length (e.g. ccs windows vs short ref subreads)
+    dq = (diag + m) // quant
     key = ((sread * 2 + strand) * index.n_reads + lread) * (
-        2 * index.length // quant + 2
+        (index.length + m) // quant + 2
     ) + dq
     uniq, inv, counts = np.unique(key, return_inverse=True, return_counts=True)
     # mean diagonal per cluster

@@ -100,6 +100,7 @@ def ccs_correct(
     # reference subread per multi-group (ccseq:356-366)
     ref_idx: List[int] = []
     members: List[List[int]] = []
+    ref_of: Dict[str, int] = {}
     for z in order:
         g = groups[z]
         if len(g) == 1:
@@ -110,6 +111,7 @@ def ccs_correct(
             ref = g[1]
         ref_idx.append(ref)
         members.append(g)
+        ref_of[z] = ref
 
     out_map: Dict[int, SeqRecord] = {}
 
@@ -148,7 +150,7 @@ def ccs_correct(
         else:
             stats.primary += 1
             stats.secondary += len(g) - 1
-            ref = [i for i in g if i in out_map]
-            if ref:
-                out.append(out_map[ref[0]])
+            # if consensus never ran for this ZMW (e.g. empty window batch),
+            # pass the raw reference subread through rather than dropping it
+            out.append(out_map.get(ref_of[z], records[ref_of[z]]))
     return out, stats

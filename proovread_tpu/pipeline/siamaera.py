@@ -37,6 +37,12 @@ class SiamaeraParams:
     window: int = 256
     overlap: int = 32
     merge_band: int = 80         # diagonal tolerance when merging window hits
+    # max query gap bridged when merging same-diagonal hits: windows that
+    # straddle the junction align through the rc'd junction (local SW has no
+    # x-drop) and fail the identity cutoff, so a joined palindrome's arms
+    # arrive with a junction-sized hole between them — but they share one
+    # diagonal, which is the siamaera signature
+    merge_gap: int = 512
     sym_tol: int = 100           # symmetry tolerance of HSP pairs
     min_hsp_len: int = 100
 
@@ -58,8 +64,9 @@ def _hsps_for_read(alns, n: int, p: SiamaeraParams) -> List[Tuple[int, int, int,
     (q_start, q_end, s_start, s_end) in (read, rc-read) coordinates."""
     hits = []
     for a in alns:
-        w_off = int(a.qname.rsplit("|w", 1)[1].split(":")[0]) if "|w" in a.qname else 0
-        q_off = int(a.qname.rsplit(":", 1)[1]) if ":" in a.qname else w_off
+        # window ids are "{read_id}|w:{start}"; the suffix is the window's
+        # offset into the read (= query offset of the window's base 0)
+        q_off = int(a.qname.rsplit(":", 1)[1]) if "|w:" in a.qname else 0
         span = a.span
         qlen = len(a.seq_codes)
         # soft-clip head length = query offset of aligned part
@@ -83,7 +90,7 @@ def _hsps_for_read(alns, n: int, p: SiamaeraParams) -> List[Tuple[int, int, int,
     for qs, qe, ss, se in hits:
         d = ss - qs
         if merged and abs((merged[-1][2] - merged[-1][0]) - d) <= p.merge_band \
-                and qs <= merged[-1][1] + p.window:
+                and qs <= merged[-1][1] + p.merge_gap:
             merged[-1][0] = min(merged[-1][0], qs)
             merged[-1][1] = max(merged[-1][1], qe)
             merged[-1][2] = min(merged[-1][2], ss)

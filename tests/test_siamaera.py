@@ -1,0 +1,98 @@
+"""Siamaera filter tests: synthetic rc-self-chimeric ("palindromic") reads.
+
+The reference detects these with a minus-strand blastn self-alignment
+(``bin/siamaera:490-534``) and trims to the longest non-chimeric arm; our
+rebuild uses a windowed SW of the read against its own reverse complement.
+These tests exercise the trim, drop, and leave-alone paths end to end.
+"""
+
+import numpy as np
+
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
+from proovread_tpu.pipeline.siamaera import SiamaeraParams, siamaera_filter
+
+
+def _rand_seq(rng, n):
+    return decode_codes(rng.integers(0, 4, n).astype(np.int8))
+
+
+def _rc(seq: str) -> str:
+    return decode_codes(revcomp_codes(encode_ascii(seq)))
+
+
+class TestSiamaera:
+    def test_clean_read_untouched(self):
+        rng = np.random.default_rng(0)
+        recs = [SeqRecord("clean", _rand_seq(rng, 800))]
+        out, stats = siamaera_filter(recs)
+        assert stats.checked == 1
+        assert stats.trimmed == 0 and stats.dropped == 0
+        assert out[0].seq == recs[0].seq
+
+    def test_joined_palindrome_trimmed(self):
+        rng = np.random.default_rng(1)
+        arm = _rand_seq(rng, 500)
+        junction = _rand_seq(rng, 40)
+        read = arm + junction + _rc(arm)          # ----R--->--J--<--R.rc--
+        out, stats = siamaera_filter([SeqRecord("siam", read)])
+        assert stats.trimmed == 1, "palindromic read not detected"
+        assert len(out) == 1
+        kept = out[0]
+        # trimmed to one arm (plus/minus junction and trim margin)
+        assert len(arm) * 0.7 <= len(kept) <= len(arm) + len(junction) + 20
+        # the kept piece is a contiguous slice of the original read
+        assert kept.seq in read
+        assert "SIAMAERA:" in (kept.desc or "")
+
+    def test_short_read_skipped(self):
+        rng = np.random.default_rng(2)
+        arm = _rand_seq(rng, 60)
+        read = arm + _rc(arm)                      # 120 < seq_min_len 150
+        out, stats = siamaera_filter([SeqRecord("short", read)])
+        assert stats.checked == 0
+        assert out[0].seq == read
+
+    def test_inconclusive_dropped(self):
+        rng = np.random.default_rng(3)
+        a = _rand_seq(rng, 400)
+        b = _rand_seq(rng, 400)
+        spacer = _rand_seq(rng, 120)
+        # two separate inverted-repeat pairs -> >2 HSPs -> inconclusive
+        read = a + _rc(a) + spacer + b + _rc(b)
+        out, stats = siamaera_filter([SeqRecord("multi", read)])
+        if stats.dropped:
+            assert all(r.id != "multi" for r in out)
+        else:
+            # merging may legitimately collapse to <=2 HSPs; then it trims
+            assert stats.trimmed == 1
+
+    def test_small_inverted_repeat_left_alone(self):
+        rng = np.random.default_rng(4)
+        body = _rand_seq(rng, 900)
+        hair = _rand_seq(rng, 120)
+        # small terminal inverted repeat: arms cover <60% of the read
+        read = hair + body + _rc(hair)
+        out, stats = siamaera_filter([SeqRecord("ir", read)])
+        assert stats.dropped == 0
+        assert out[0].seq == read
+
+    def test_mixed_batch_order_and_quals(self):
+        rng = np.random.default_rng(5)
+        arm = _rand_seq(rng, 400)
+        pal = arm + _rand_seq(rng, 30) + _rc(arm)
+        clean = _rand_seq(rng, 700)
+        q_pal = rng.integers(10, 40, len(pal)).astype(np.uint8)
+        recs = [
+            SeqRecord("c1", clean, qual=np.full(700, 30, np.uint8)),
+            SeqRecord("p1", pal, qual=q_pal),
+        ]
+        out, stats = siamaera_filter(recs)
+        assert stats.trimmed == 1
+        ids = [r.id for r in out]
+        assert ids == ["c1", "p1"]
+        p_out = out[1]
+        # quality array trimmed in lockstep with the sequence
+        assert p_out.qual is not None and len(p_out.qual) == len(p_out.seq)
+        start = pal.index(p_out.seq)
+        assert np.array_equal(p_out.qual, q_pal[start:start + len(p_out)])
