@@ -1,0 +1,304 @@
+"""Banded Smith-Waterman Pallas kernel with in-kernel path expansion.
+
+The TPU-native core of the bwa-proovread role (SURVEY §2.2): one kernel
+computes, per candidate, the banded affine-gap DP *and* walks the optimal
+path back — emitting the alignment directly in expanded per-window-column
+form (state code / consuming query row / insertion count per reference
+column) instead of a CIGAR op stream. That removes both scalability killers
+of the ``lax.scan`` implementation (``align/sw.py``): the [R, m, n] direction
+tensor round-tripping through HBM, and the serial per-step traceback scan.
+
+Band layout: lane w = j - i (ref col minus query row) relative to the
+window, w in [0, W).  Windows are cut by the seeder so the expected
+diagonal sits at w = W//2; the DP explores +-W/2 of drift, mirroring bwa's
+``-w`` band (``proovread.cfg:325``).
+
+The backward walk is exactly one step per query row: deletion runs collapse
+to a single vectorized range-mark because the forward pass stores, per cell,
+the *origin* of the optimal in-row deletion chain (computed as the payload
+of the log-shift running-max that solves the within-row E recurrence).
+
+Scoring, boundary and tie-break semantics mirror ``align/sw.py`` bit-for-bit
+(same f32 math): M wins score ties against F and E, deletion extension wins
+ties against re-opening, insertion opening wins ties against extension, and
+end cells resolve ties in row-major (i, j) order.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from proovread_tpu.align.params import AlignParams
+from proovread_tpu.ops.encode import GAP
+
+NEG = np.float32(-1e9)
+
+# dirs word layout (int32 per cell)
+#   bits 0-1: H' source: 0 = M starting the alignment, 1 = M continuing, 2 = F
+#   bit 2:    H realized by E (deletion) rather than H'
+#   bit 3:    F extends the previous insertion (vs opening from H)
+#   bits 8-15: origin lane of the optimal in-row deletion chain ending here
+
+
+class BswResult(NamedTuple):
+    """Expanded alignments, window-column major (device arrays)."""
+    state: jnp.ndarray    # i32 [R, n] voted state per window col (-1 = none)
+    qrow: jnp.ndarray     # i32 [R, n] 0-based query row consuming the col
+    ins_len: jnp.ndarray  # i32 [R, n] inserted bases attached after the col
+    score: jnp.ndarray    # f32 [R] raw local score (clip penalties undone)
+    q_start: jnp.ndarray  # i32 [R] first aligned query base
+    q_end: jnp.ndarray    # i32 [R] one past last aligned query base
+    r_start: jnp.ndarray  # i32 [R] window-relative ref start
+    r_end: jnp.ndarray    # i32 [R] one past last aligned window col
+    valid: jnp.ndarray    # bool [R]
+
+
+def _shift_down(x, s, fill):
+    """x[w-s] along the sublane (w) axis: rows < s become `fill`."""
+    if s == 0:
+        return x
+    pad = jnp.full((s,) + x.shape[1:], fill, x.dtype)
+    return jnp.concatenate([pad, x[:-s]], axis=0)
+
+
+def _shift_up(x, s, fill):
+    """x[w+s] along the sublane (w) axis: rows >= W-s become `fill`."""
+    if s == 0:
+        return x
+    pad = jnp.full((s,) + x.shape[1:], fill, x.dtype)
+    return jnp.concatenate([x[s:], pad], axis=0)
+
+
+def _extract(slab, onehot, fill):
+    """Per-lane value of [W, C] `slab` at the lane's one-hot w index."""
+    return jnp.max(jnp.where(onehot, slab, fill), axis=0, keepdims=True)
+
+
+def _bsw_kernel(qlen_ref, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
+                stats_ref, dirs_ref, *, m, W, C, p: AlignParams):
+    n = m + W
+    match = jnp.float32(p.match)
+    mismatch = jnp.float32(p.mismatch)
+    n_pen = jnp.float32(p.n_penalty)
+    o_del, e_del = jnp.float32(p.o_del), jnp.float32(p.e_del)
+    o_ins, e_ins = jnp.float32(p.o_ins), jnp.float32(p.e_ins)
+    clip = jnp.float32(p.clip)
+
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (W, C), 0)
+    iota_wf = iota_w.astype(jnp.float32)
+    qlen = qlen_ref[0:1, :]                       # [1, C] i32
+
+    # ---------------- forward banded DP ----------------
+    def fwd(r, carry):
+        h_prev, f_prev, best, best_pay = carry
+        qr = q_ref[r, :][None, :]                 # [1, C] i32
+        wslab = win_ref[pl.ds(r, W), :]           # [W, C] i32
+        ambig = (qr > 3) | (wslab > 3)
+        sub = jnp.where(ambig, -n_pen,
+                        jnp.where(wslab == qr, match, -mismatch))
+
+        start_score = jnp.where(r == 0, 0.0, -clip).astype(jnp.float32)
+        diag = h_prev
+        diag_base = jnp.maximum(diag, start_score)
+        src0 = start_score > diag                 # start beats diag (strict)
+        m_row = diag_base + sub
+
+        h_up = _shift_up(h_prev, 1, NEG)          # H(i-1, w+1)
+        f_up = _shift_up(f_prev, 1, NEG)
+        f_open = jnp.where(r == 0, NEG, h_up - (o_ins + e_ins))
+        f_ext = f_up - e_ins
+        f_row = jnp.maximum(f_open, f_ext)
+        fext = f_ext > f_open                     # open wins ties
+
+        hp = jnp.maximum(m_row, f_row)
+        src = jnp.where(f_row > m_row, 2,
+                        jnp.where(src0, 0, 1)).astype(jnp.int32)
+
+        # within-row deletion: E[w] = max_{k<w} (hp[k] - o_del - (w-k) e_del)
+        # solved as a log-shift running max of hp[k] + k*e_del with the
+        # arg (origin lane k) carried as payload; ties keep the smaller k,
+        # matching sw.py's extension-wins-ties rule.
+        u = hp + iota_wf * e_del
+        pay = iota_w
+        s = 1
+        while s < W:
+            us = _shift_down(u, s, NEG)
+            ps = _shift_down(pay, s, 0)
+            take = us >= u
+            u = jnp.where(take, us, u)
+            pay = jnp.where(take, ps, pay)
+            s <<= 1
+        u_excl = _shift_down(u, 1, NEG)
+        pay_excl = _shift_down(pay, 1, 0)
+        e_row = u_excl - o_del - iota_wf * e_del
+        h_row = jnp.maximum(hp, e_row)
+        bit_e = e_row > hp                        # H' wins ties
+
+        word = (src
+                | jnp.where(bit_e, 4, 0)
+                | jnp.where(fext, 8, 0)
+                | (pay_excl << 8))
+        dirs_ref[r] = word
+
+        tailpen = jnp.where(r == qlen - 1, 0.0, clip)
+        sel = jnp.where(r < qlen, h_row - tailpen, NEG)
+        better = sel > best                       # earlier row wins ties
+        best = jnp.maximum(best, sel)
+        best_pay = jnp.where(better, (r << 7) | iota_w, best_pay)
+        return h_row, f_row, best, best_pay
+
+    zeros = jnp.zeros((W, C), jnp.float32)
+    init = (zeros, jnp.full((W, C), NEG), jnp.full((W, C), NEG),
+            jnp.zeros((W, C), jnp.int32))
+    _, _, best, best_pay = jax.lax.fori_loop(0, m, fwd, init)
+
+    # end-cell selection: flat argmax in row-major (i, j) order = the
+    # smallest packed (r, w) among the lanes achieving the max
+    m1 = jnp.max(best, axis=0, keepdims=True)                    # [1, C]
+    BIGP = jnp.int32(1 << 30)
+    pay_sel = jnp.min(jnp.where(best == m1, best_pay, BIGP),
+                      axis=0, keepdims=True)                      # [1, C]
+    end_r = pay_sel >> 7
+    end_w = pay_sel & 127
+    valid = (m1 > NEG / 2) & (qlen > 0)
+    h_best = m1 + jnp.where(end_r == qlen - 1, 0.0, clip)
+
+    # ---------------- backward walk: one step per query row ----------------
+    state_ref[:] = jnp.full((n, C), -1, jnp.int32)
+    qrow_ref[:] = jnp.zeros((n, C), jnp.int32)
+    inslen_ref[:] = jnp.zeros((n, C), jnp.int32)
+
+    def bwd(t, carry):
+        cur_w, mode, done_i, q_start, r_start = carry
+        r = m - 1 - t
+        active = (done_i == 0) & (r <= end_r)
+        hot_cur = iota_w == cur_w
+        word = _extract(dirs_ref[r], hot_cur, -1)                 # [1, C]
+
+        is_h = active & (mode == 0)
+        bit_e = ((word >> 2) & 1) == 1
+        dj = is_h & bit_e
+        w_h = jnp.where(dj, (word >> 8) & 0xFF, cur_w)
+        hot_h = iota_w == w_h
+        word2 = jnp.where(dj, _extract(dirs_ref[r], hot_h, -1), word)
+        src = word2 & 3
+        is_m = is_h & (src <= 1)
+        is_i_open = is_h & (src == 2)
+        is_i_chain = active & (mode == 1)
+        is_i = is_i_open | is_i_chain
+        fext = jnp.where(is_i_open, (word2 >> 3) & 1, (word >> 3) & 1) == 1
+        att_w = jnp.where(is_i_open, w_h, cur_w)
+        hot_att = iota_w == att_w
+
+        dmask = dj & (iota_w > w_h) & (iota_w <= cur_w)           # [W, C]
+        mhot = hot_h & is_m
+        ihot = hot_att & is_i
+
+        qbase = q_ref[r, :][None, :]
+        slab = state_ref[pl.ds(r, W), :]
+        slab = jnp.where(dmask, jnp.int32(GAP), slab)
+        slab = jnp.where(mhot, qbase, slab)
+        state_ref[pl.ds(r, W), :] = slab
+        qslab = qrow_ref[pl.ds(r, W), :]
+        qrow_ref[pl.ds(r, W), :] = jnp.where(dmask | mhot, r, qslab)
+        islab = inslen_ref[pl.ds(r, W), :]
+        inslen_ref[pl.ds(r, W), :] = islab + jnp.where(ihot, 1, 0)
+
+        started = is_m & ((src == 0) | (r == 0))
+        q_start = jnp.where(started, r, q_start)
+        r_start = jnp.where(started, r + w_h, r_start)
+        done_i = jnp.where(started, 1, done_i)
+        mode = jnp.where(is_m, 0, jnp.where(is_i, jnp.where(fext, 1, 0), mode))
+        cur_w = jnp.where(is_m & ~started, w_h,
+                          jnp.where(is_i, att_w + 1, cur_w))
+        return cur_w, mode, done_i, q_start, r_start
+
+    z1 = jnp.zeros((1, C), jnp.int32)
+    _, _, _, q_start, r_start = jax.lax.fori_loop(
+        0, m, bwd, (end_w, z1, jnp.where(valid, 0, 1), z1, z1))
+
+    score = h_best + jnp.where(q_start > 0, clip, 0.0)
+    stats_ref[0:1, :] = jnp.where(valid, score, NEG)
+    stats_ref[1:2, :] = q_start.astype(jnp.float32)
+    stats_ref[2:3, :] = (end_r + 1).astype(jnp.float32)
+    stats_ref[3:4, :] = r_start.astype(jnp.float32)
+    stats_ref[4:5, :] = (end_r + end_w + 1).astype(jnp.float32)
+    stats_ref[5:6, :] = valid.astype(jnp.float32)
+
+
+def _block_candidates(m: int) -> int:
+    """Candidates per kernel program, sized so dirs fits VMEM."""
+    return 128 if m <= 256 else 64
+
+
+def band_lanes(params: AlignParams) -> int:
+    """Band width in lanes: covers 2x the configured bwa band, padded to the
+    int8/int32 sublane tile."""
+    w = 2 * params.band_width
+    return max(32, ((w + 31) // 32) * 32)
+
+
+@functools.partial(jax.jit, static_argnames=("params", "interpret"))
+def bsw_expand(q, win, qlen, params: AlignParams,
+               interpret: bool = False) -> BswResult:
+    """Align + expand a candidate batch.
+
+    q:   i8 [R, m] query codes (strand-oriented, N-padded)
+    win: i8 [R, n] ref window codes, n = m + band_lanes(params)
+    qlen: i32 [R]
+    """
+    R, m = q.shape
+    W = band_lanes(params)
+    n = m + W
+    assert win.shape == (R, n), (win.shape, (R, n))
+    C = _block_candidates(m)
+    assert R % C == 0, (R, C)
+
+    qT = q.astype(jnp.int32).T                     # [m, R]
+    winT = win.astype(jnp.int32).T                 # [n, R]
+    qlen2 = qlen.astype(jnp.int32)[None, :]        # [1, R]
+
+    kernel = functools.partial(_bsw_kernel, m=m, W=W, C=C, p=params)
+    grid = (R // C,)
+    state, qrow, inslen, stats = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, C), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, C), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, C), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((n, C), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, C), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, C), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, C), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
+            jax.ShapeDtypeStruct((8, R), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((m, W, C), jnp.int32)],
+        interpret=interpret,
+    )(qlen2, qT, winT)
+
+    return BswResult(
+        state=state.T, qrow=qrow.T, ins_len=inslen.T,
+        score=stats[0], q_start=stats[1].astype(jnp.int32),
+        q_end=stats[2].astype(jnp.int32), r_start=stats[3].astype(jnp.int32),
+        r_end=stats[4].astype(jnp.int32), valid=stats[5] > 0.5,
+    )
+
+
+def default_interpret() -> bool:
+    """Pallas interpret mode for non-TPU backends (CPU tests, dryruns)."""
+    return jax.default_backend() != "tpu"
