@@ -1,0 +1,194 @@
+"""Device-side seeding: strided k-mer probes -> extension candidates.
+
+TPU-native replacement for the host seeder (``align/seed.py``) in the hot
+path. The host version votes with *every* query k-mer position and ranks
+clusters with global sorts — O(hits log hits) on a single host core. Here,
+each short read fires a small set of strided probe k-mers into a sorted
+index of the long-read batch; each probe contributes up to ``occ_cap`` hits,
+and candidate (long read, diagonal-bucket) clusters are extracted per
+(read, strand) with tiny fixed-width in-row comparisons — no global sort,
+no scatter, everything dense and batched.
+
+This mirrors the role of bwa's seeding stage (SURVEY §2.2): sparse seeds,
+cheap voting, extension does the real work. Sensitivity knobs: probe
+``stride`` (a true placement gets ~(qlen/stride) chances), ``occ_cap``
+(repeat truncation; the host's ``max_occ`` analog) and ``min_votes``.
+
+Masked (N) reference columns form no index k-mers, so corrected regions
+stop attracting seeds exactly as with the host seeder.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from proovread_tpu.align.params import AlignParams
+
+
+class DeviceIndex(NamedTuple):
+    kmers: jnp.ndarray   # u32 [M] sorted k-mer values (0xFFFFFFFF = invalid)
+    gpos: jnp.ndarray    # i32 [M] read * L + offset
+    k: int
+    length: int          # L of the indexed batch
+    n_reads: int
+
+
+class DeviceCandidates(NamedTuple):
+    """Dense candidate slots [Bq, 2, S]; invalid slots have lread = -1."""
+    lread: jnp.ndarray   # i32 [Bq, 2, S]
+    diag: jnp.ndarray    # i32 [Bq, 2, S] mean cluster diagonal
+    votes: jnp.ndarray   # i32 [Bq, 2, S]
+
+
+def _rolling_kmers(codes: jnp.ndarray, lengths: jnp.ndarray, k: int):
+    """codes i8/i32 [B, L] -> (u32 values [B, L-k+1], valid mask)."""
+    B, L = codes.shape
+    c = codes.astype(jnp.uint32)
+    n_pos = L - k + 1
+    vals = jnp.zeros((B, n_pos), jnp.uint32)
+    bad = jnp.zeros((B, n_pos), bool)
+    for i in range(k):
+        w = jax.lax.dynamic_slice_in_dim(c, i, n_pos, axis=1)
+        vals = (vals << 2) | (w & 3)
+        bad = bad | (w > 3)
+    pos = jnp.arange(n_pos, dtype=jnp.int32)[None, :]
+    valid = (~bad) & ((pos + k) <= lengths[:, None])
+    return vals, valid
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def build_index(codes: jnp.ndarray, lengths: jnp.ndarray, k: int):
+    """Sorted k-mer table of a long-read batch (device)."""
+    B, L = codes.shape
+    vals, valid = _rolling_kmers(codes, lengths, k)
+    n_pos = vals.shape[1]
+    keys = jnp.where(valid, vals, jnp.uint32(0xFFFFFFFF)).reshape(-1)
+    pos = jnp.arange(n_pos, dtype=jnp.int32)[None, :]
+    gpos = (jnp.arange(B, dtype=jnp.int32)[:, None] * L + pos)
+    gpos = jnp.broadcast_to(gpos, vals.shape).reshape(-1)
+    skeys, sgpos = jax.lax.sort([keys, gpos], num_keys=1)
+    return skeys, sgpos
+
+
+def device_index(codes, lengths, k: int) -> DeviceIndex:
+    B, L = codes.shape
+    skeys, sgpos = build_index(codes, lengths, k)
+    return DeviceIndex(kmers=skeys, gpos=sgpos, k=k, length=L, n_reads=B)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "stride", "occ_cap", "slots", "quant",
+                     "max_occ", "min_votes"),
+)
+def _probe(index_kmers, index_gpos, q_codes, q_lengths, rc_codes,
+           *, k, L, stride, occ_cap, slots, quant, max_occ, min_votes):
+    Bq, m = q_codes.shape
+    probes = []
+    for strand, qc in ((0, q_codes), (1, rc_codes)):
+        vals, valid = _rolling_kmers(qc, q_lengths, k)
+        ps = jnp.arange(0, vals.shape[1], stride, dtype=jnp.int32)
+        probes.append((vals[:, ps], valid[:, ps], ps))
+    P = probes[0][0].shape[1]
+
+    INVALID = jnp.int32(1 << 29)
+    DQ_SPAN = (L + m) // quant + 2
+
+    keys_all, diags_all = [], []
+    for strand in (0, 1):
+        vals, valid, ps = probes[strand]
+        flat = jnp.where(valid, vals, jnp.uint32(0xFFFFFFFE)).reshape(-1)
+        lo = jnp.searchsorted(index_kmers, flat, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(index_kmers, flat, side="right").astype(jnp.int32)
+        occ = hi - lo
+        use = valid.reshape(-1) & (occ > 0) & (occ <= max_occ)
+        occ_use = jnp.minimum(occ, occ_cap)
+        hit_keys, hit_diags = [], []
+        qpos = jnp.broadcast_to(ps[None, :], (Bq, P)).reshape(-1)
+        M = index_gpos.shape[0]
+        for j in range(occ_cap):
+            idx = jnp.clip(lo + j, 0, M - 1)
+            g = index_gpos[idx]
+            lread = g // L
+            rpos = g % L
+            diag = rpos - qpos
+            dq = (diag + m) // quant
+            key = lread * DQ_SPAN + dq
+            ok = use & (j < occ_use)
+            hit_keys.append(jnp.where(ok, key, INVALID))
+            hit_diags.append(jnp.where(ok, diag, 0))
+        keys_all.append(jnp.stack(hit_keys, -1).reshape(Bq, P * occ_cap))
+        diags_all.append(jnp.stack(hit_diags, -1).reshape(Bq, P * occ_cap))
+
+    keys = jnp.stack(keys_all, 1)     # [Bq, 2, P*occ_cap]
+    diags = jnp.stack(diags_all, 1)
+    S_in = keys.shape[-1]
+
+    # cluster votes within each row: O(S_in^2) dense comparisons
+    eq = keys[..., :, None] == keys[..., None, :]          # [Bq, 2, S, S]
+    votes = eq.sum(-1).astype(jnp.int32)
+    dsum = (eq * diags[..., None, :]).sum(-1)
+    tri = jnp.tril(jnp.ones((S_in, S_in), bool), k=-1)
+    first = ~(eq & tri).any(-1)                            # first occurrence
+    live = first & (keys != INVALID) & (votes >= min_votes)
+
+    mean_diag = (dsum + votes // 2) // jnp.maximum(votes, 1)
+    neg_rank = jnp.where(live, -votes, 1 << 30)
+    _, key_s, diag_s, votes_s = jax.lax.sort(
+        [neg_rank, keys, mean_diag, votes], num_keys=1, dimension=-1)
+    key_top = key_s[..., :slots]
+    lread = jnp.where(key_top < INVALID, key_top // DQ_SPAN, -1)
+    return DeviceCandidates(
+        lread=lread.astype(jnp.int32),
+        diag=diag_s[..., :slots].astype(jnp.int32),
+        votes=votes_s[..., :slots].astype(jnp.int32),
+    )
+
+
+def probe_candidates(
+    index: DeviceIndex,
+    q_codes: jnp.ndarray,     # i8 [Bq, m] (N-padded)
+    q_lengths: jnp.ndarray,
+    rc_codes: jnp.ndarray,    # i8 [Bq, m] revcomp, left-aligned
+    params: AlignParams,
+    stride: int = 16,
+    occ_cap: int = 4,
+    min_votes: int = 2,
+) -> DeviceCandidates:
+    quant = max(params.band_width // 2, 1)
+    return _probe(
+        index.kmers, index.gpos, q_codes, q_lengths, rc_codes,
+        k=index.k, L=index.length, stride=stride, occ_cap=occ_cap,
+        slots=params.max_candidates, quant=quant, max_occ=params.max_occ,
+        min_votes=min_votes,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=())
+def compact_candidates(cand: DeviceCandidates):
+    """Flatten candidate slots to a dense prefix sorted by (lread, diag).
+
+    Returns (sread, strand, lread, diag, n_valid) — all device arrays; the
+    dense prefix of length n_valid holds the real candidates, the tail is
+    padding with lread = last valid read (safe for the pileup kernel).
+    """
+    Bq, two, S = cand.lread.shape
+    lread = cand.lread.reshape(-1)
+    diag = cand.diag.reshape(-1)
+    idx = jnp.arange(Bq * two * S, dtype=jnp.int32)
+    sread = idx // (two * S)
+    strand = (idx // S) % two
+    valid = lread >= 0
+    BIG = jnp.int32(1 << 30)
+    order_key = jnp.where(valid, lread, BIG)
+    _, o_sread, o_strand, o_lread, o_diag = jax.lax.sort(
+        [order_key, sread, strand, lread, diag], num_keys=1)
+    n_valid = valid.sum()
+    # pad tail lreads with the last valid lread (keeps read_of sorted)
+    last = jnp.max(jnp.where(valid, lread, 0))
+    o_lread = jnp.where(o_lread < 0, last, o_lread)
+    return o_sread, o_strand.astype(jnp.int8), o_lread, o_diag, n_valid

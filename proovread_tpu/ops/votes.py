@@ -1,0 +1,168 @@
+"""Vote building: expanded alignments (align/bsw.py) -> packed vote slabs.
+
+Pure-XLA twin of ``ops/fused.py:fused_accumulate``'s vote-extraction logic,
+operating on the kernel's per-window-column representation instead of the
+traceback op stream. Produces one packed f32 slab per candidate that the
+Pallas pileup kernel (``ops/pileup_kernel.py``) adds into per-read pileup
+tensors with a single dense vector add — no XLA scatter in the hot path.
+
+Packed lane layout (PACK_LANES wide, f32):
+    [0:6)    per-state column votes            (Pileup.counts)
+    [8:14)   per-state has-insertion markers   (Pileup.ins_mbase)
+    [16:22)  insertion length-bucket votes     (Pileup.ins_len_votes, K=6)
+    [24:54)  inserted-base votes, offset-major (Pileup.ins_base_votes, K*5)
+
+Semantics mirrored exactly from fused_accumulate (same deviations from the
+Perl reference, documented there): the bowtie2/bwa 1D1I quirk rewrite, the
+positional InDelTaboo gate — including its effect on insertion runs crossing
+the kept-region boundary (masked steps shift the run's forward offsets and
+shorten its length vote) — per-step qual weighting, MCR ignore masking and
+window bounds.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from proovread_tpu.ops.encode import GAP, N_STATES
+
+PACK_LANES = 64
+INS_CAP = 6  # must match ConsensusParams.ins_cap / Pileup K
+
+
+def _phred2freq(p):
+    """round((phred^2/120)*100)/100 (Sam/Seq.pm:151-156)."""
+    return jnp.round((p.astype(jnp.float32) ** 2 / 120.0) * 100.0) / 100.0
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("qual_weighted", "taboo_frac", "taboo_abs",
+                     "min_aln_length"),
+)
+def build_votes(
+    state: jnp.ndarray,     # i32 [R, n] window-col state (-1 = none)
+    qrow: jnp.ndarray,      # i32 [R, n] consuming query row
+    ins_len: jnp.ndarray,   # i32 [R, n] inserted bases after the col
+    q: jnp.ndarray,         # i32/i8 [R, m] query codes (strand-oriented)
+    qual: jnp.ndarray,      # u8  [R, m] query phreds (strand-oriented)
+    q_start: jnp.ndarray,   # i32 [R]
+    q_end: jnp.ndarray,     # i32 [R]
+    keep: jnp.ndarray,      # bool [R] admitted
+    ignore_cols: jnp.ndarray | None = None,  # bool [R, n] MCR columns
+    in_bounds: jnp.ndarray | None = None,    # bool [R, n] col within read
+    qual_weighted: bool = False,
+    taboo_frac: float = 0.1,
+    taboo_abs: int = 0,
+    min_aln_length: int = 50,
+) -> jnp.ndarray:
+    """Returns packed vote slabs f32 [R, n, PACK_LANES]."""
+    R, n = state.shape
+    m = q.shape[1]
+    K = INS_CAP
+    q = q.astype(jnp.int32)
+    qualf = qual.astype(jnp.int32)
+
+    aln_len = q_end - q_start
+    if taboo_abs:
+        taboo = jnp.full((R,), taboo_abs, jnp.int32)
+    else:
+        taboo = jnp.floor(aln_len * taboo_frac + 0.5).astype(jnp.int32)
+    kept_lo = q_start + taboo
+    kept_hi = q_end - taboo
+    ok = (
+        keep
+        & (aln_len > min_aln_length)
+        & ((kept_hi - kept_lo) >= min_aln_length)
+        & ((kept_hi - kept_lo) >= 0.7 * aln_len)
+    )
+
+    # 1D1I quirk (Sam/Seq.pm:413-419): a deletion column that also carries an
+    # insertion run is the D+I(run) pattern — the first inserted base is
+    # really a mismatch. Rewrite: the column becomes an M of that base.
+    gapins = (state == GAP) & (ins_len > 0)
+    qrow = jnp.where(gapins, qrow + 1, qrow)
+    base_at = jnp.take_along_axis(q, jnp.clip(qrow, 0, m - 1), axis=1)
+    state = jnp.where(gapins, base_at, state)
+    ins_len = jnp.where(gapins, ins_len - 1, ins_len)
+
+    has_state = state >= 0
+    in_keep = (qrow >= kept_lo[:, None]) & (qrow < kept_hi[:, None])
+    col_ok = ok[:, None]
+    if ignore_cols is not None:
+        col_ok = col_ok & ~ignore_cols
+    if in_bounds is not None:
+        col_ok = col_ok & in_bounds
+    live = has_state & in_keep & col_ok
+
+    qq = jnp.take_along_axis(qualf, jnp.clip(qrow, 0, m - 1), axis=1)
+    qq_next = jnp.take_along_axis(qualf, jnp.clip(qrow + 1, 0, m - 1), axis=1)
+    if qual_weighted:
+        w_m = _phred2freq(qq)
+        w_d = _phred2freq(jnp.minimum(qq, qq_next))
+    else:
+        w_m = jnp.ones((R, n), jnp.float32)
+        w_d = w_m
+    is_d = state == GAP
+    weight = jnp.where(live, jnp.where(is_d, w_d, w_m), 0.0)
+
+    st = jnp.clip(state, 0, N_STATES - 1)
+    lanes = jnp.arange(PACK_LANES, dtype=jnp.int32)
+    packed = (lanes[None, None, :] == st[:, :, None]) * weight[:, :, None]
+
+    # ---- insertion votes (taboo-gated per inserted base) ----
+    # inserted base k (forward offset) was consumed at query row qrow+1+k;
+    # masked prefix steps shift the effective run start (k0) and masked
+    # suffix steps shorten it — mirroring fused_accumulate's gated is_i runs.
+    first_qi = qrow + 1
+    k0 = jnp.clip(kept_lo[:, None] - first_qi, 0, 1 << 20)
+    kept_len = jnp.minimum(ins_len, kept_hi[:, None] - first_qi)
+    eff_len = jnp.clip(kept_len - k0, 0, 1 << 20)
+    ins_live = col_ok & (ins_len > 0)
+    eff_live = ins_live & (eff_len > 0)
+
+    # length-bucket vote: weight of the last kept inserted base (fused's
+    # run_end step in the reversed stream = the forward-last I)
+    qi_last = jnp.clip(first_qi + k0 + eff_len - 1, 0, m - 1)
+    w_last = _phred2freq(jnp.take_along_axis(qualf, qi_last, axis=1)) \
+        if qual_weighted else jnp.ones((R, n), jnp.float32)
+    lbucket = jnp.clip(eff_len - 1, 0, K - 1)
+    lw = jnp.where(eff_live, w_last, 0.0)
+    packed = packed + (lanes[None, None, :] == (16 + lbucket[:, :, None])) \
+        * lw[:, :, None]
+
+    # has-insertion marker: requires the run's original first step kept
+    # (fused: m_has_ins = is_m & prev_is_i over the *gated* stream)
+    mb = live & ~is_d & eff_live & (k0 == 0)
+    packed = packed + (lanes[None, None, :] == (8 + st[:, :, None])) \
+        * jnp.where(mb, weight, 0.0)[:, :, None]
+
+    # per-offset inserted-base votes
+    for k in range(K):
+        qi_k = jnp.clip(first_qi + k0 + k, 0, m - 1)
+        b_k = jnp.take_along_axis(q, qi_k, axis=1)
+        w_k = _phred2freq(jnp.take_along_axis(qualf, qi_k, axis=1)) \
+            if qual_weighted else jnp.ones((R, n), jnp.float32)
+        v_k = jnp.where(eff_live & (k < eff_len), w_k, 0.0)
+        lane_k = 24 + 5 * k + jnp.clip(b_k, 0, 4)
+        packed = packed + (lanes[None, None, :] == lane_k[:, :, None]) \
+            * v_k[:, :, None]
+
+    return packed
+
+
+def unpack_pileup(pileup_packed: jnp.ndarray, pad: int, length: int):
+    """Packed [B, pad + L + pad, PACK_LANES] -> Pileup tensors."""
+    from proovread_tpu.ops.pileup import Pileup
+
+    core = pileup_packed[:, pad:pad + length, :]
+    K = INS_CAP
+    counts = core[:, :, 0:N_STATES]
+    ins_mbase = core[:, :, 8:8 + N_STATES]
+    ins_len_votes = core[:, :, 16:16 + K]
+    B, L = core.shape[0], core.shape[1]
+    ins_base_votes = core[:, :, 24:24 + 5 * K].reshape(B, L, K, 5)
+    return Pileup(counts, ins_mbase, ins_len_votes, ins_base_votes)
