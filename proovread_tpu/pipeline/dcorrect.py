@@ -1,0 +1,351 @@
+"""Device-resident iterative correction — the TPU throughput path.
+
+The host pipeline (``pipeline/driver.py`` + ``pipeline/correct.py``) keeps
+per-iteration state (consensus reads, masks) on the host and pays a
+device round trip per stage; on the tunneled single-chip setup every
+device->host fetch costs ~100ms of latency, so the iteration loop here keeps
+ALL evolving state on device:
+
+    masked codes -> k-mer index -> probe seeding -> banded-SW Pallas kernel
+    -> threshold + binned admission -> vote slabs -> pileup Pallas kernel
+    -> consensus call -> on-device assembly of the corrected reads
+    -> on-device HCR masking
+
+Only two host syncs happen per iteration: the candidate count (sizes the
+chunk loop) and the masked-% KPI (drives the reference's mask-shortcut,
+``bin/proovread:2026-2047``). Corrected reads are fetched once, after the
+finish pass.
+
+Algorithmic semantics mirror the host path (same vote/consensus/admission
+code paths or verified twins); the seeder is the strided-probe device seeder
+(``align/dseed.py``) rather than the all-positions host voter — a documented
+mapper-heuristic difference of the same kind the reference accepts between
+its own mapper generations (bwa vs shrimp schedules, ``proovread.cfg``).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from proovread_tpu.align import bsw, dseed
+from proovread_tpu.align.params import AlignParams
+from proovread_tpu.consensus.params import NCSCORE_CONSTANT, ConsensusParams
+from proovread_tpu.io.batch import pack_reads
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops.consensus_call import ConsensusCall, call_consensus
+from proovread_tpu.ops.encode import N
+from proovread_tpu.ops.fused import add_ref_votes
+from proovread_tpu.ops.pileup_kernel import pileup_accumulate
+from proovread_tpu.ops.votes import PACK_LANES, build_votes, unpack_pileup
+from proovread_tpu.pipeline.masking import MaskParams
+
+log = logging.getLogger("proovread_tpu")
+
+
+# --------------------------------------------------------------------------
+# device helpers
+# --------------------------------------------------------------------------
+
+@jax.jit
+def device_revcomp(codes: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Per-row reverse complement, left-aligned (pad stays at the tail)."""
+    B, m = codes.shape
+    j = jnp.arange(m, dtype=jnp.int32)[None, :]
+    src = jnp.clip(lengths[:, None] - 1 - j, 0, m - 1)
+    g = jnp.take_along_axis(codes, src, axis=1)
+    rc = jnp.where(g < 4, 3 - g, g)
+    return jnp.where(j < lengths[:, None], rc, 4).astype(codes.dtype)
+
+
+@jax.jit
+def device_reverse_rows(x: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Reverse each row's first lengths[i] entries."""
+    B, m = x.shape
+    j = jnp.arange(m, dtype=jnp.int32)[None, :]
+    src = jnp.clip(lengths[:, None] - 1 - j, 0, m - 1)
+    out = jnp.take_along_axis(x, src, axis=1)
+    return jnp.where(j < lengths[:, None], out, x)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def device_admit(
+    lread: jnp.ndarray,     # i32 [R]
+    pos0: jnp.ndarray,      # i32 [R] ref start
+    span: jnp.ndarray,      # i32 [R]
+    score: jnp.ndarray,     # f32 [R]
+    passed: jnp.ndarray,    # bool [R] threshold + validity
+    ref_lens: jnp.ndarray,  # i32 [B]
+    params: ConsensusParams,
+) -> jnp.ndarray:
+    """jnp twin of consensus/alnset.py:admit_mask (same sort keys, same
+    crossing-alignment admission rule)."""
+    R = lread.shape[0]
+    keep = passed & (span > 0)
+    eff = -score if params.invert_scores else score
+    spanf = span.astype(jnp.float32)
+    ncscore = jnp.where(span > 0, eff / (NCSCORE_CONSTANT + spanf), -jnp.inf)
+    if params.min_score is not None:
+        keep &= eff >= params.min_score
+    if params.min_nscore is not None:
+        keep &= jnp.where(span > 0, eff / jnp.maximum(spanf, 1.0), -jnp.inf) \
+            >= params.min_nscore
+    if params.min_ncscore is not None:
+        keep &= ncscore >= params.min_ncscore
+
+    bs = params.bin_size
+    n_bins = ref_lens // bs + 1
+    bin_of = ((pos0 + 1 + spanf / 2) / bs).astype(jnp.int32)
+    bin_of = jnp.clip(bin_of, 0, n_bins[jnp.clip(lread, 0, None)] - 1)
+    gbin = lread * jnp.max(n_bins) + bin_of
+    BIG = jnp.int32(1 << 30)
+    primary = jnp.where(keep, gbin, BIG)
+
+    idx = jnp.arange(R, dtype=jnp.int32)
+    order = jnp.lexsort((idx, -ncscore, primary))
+    sbins = primary[order]
+    sspans = jnp.where(keep, spanf, 0.0)[order]
+    cum = jnp.cumsum(sspans)
+    first = jnp.searchsorted(sbins, sbins, side="left")
+    before = jnp.where(first > 0, cum[jnp.maximum(first - 1, 0)], 0.0)
+    cum_before = cum - sspans - before
+    admit = keep[order] & (cum_before <= params.bin_max_bases)
+    return jnp.zeros(R, bool).at[order].set(admit)
+
+
+@functools.partial(jax.jit, static_argnames=("Lp",))
+def device_assemble(call: ConsensusCall, ref_qual: jnp.ndarray,
+                    lengths: jnp.ndarray, Lp: int):
+    """On-device twin of consensus/engine.py:assemble_consensus (sequence
+    part): emitted columns + inserted bases -> new packed codes/qual/lengths.
+    Output longer than Lp is truncated (the pad carries slack)."""
+    B, L = call.base.shape
+    valid_col = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
+    emit_counts = jnp.where(valid_col & call.emitted, 1 + call.ins_len, 0)
+    cum = jnp.cumsum(emit_counts, axis=1)               # inclusive
+    new_len = jnp.minimum(cum[:, -1], Lp)
+
+    # output position p comes from source column src = first col with
+    # cum[col] > p; offset within the column: 0 = base, k>0 = ins_bases[k-1]
+    p = jnp.arange(Lp, dtype=jnp.int32)
+
+    def row(cum_r, base_r, insb_r, phred_r):
+        src = jnp.searchsorted(cum_r, p, side="right").astype(jnp.int32)
+        src_c = jnp.clip(src, 0, L - 1)
+        prev = jnp.where(src_c > 0, cum_r[jnp.maximum(src_c - 1, 0)], 0)
+        off = p - prev
+        K = insb_r.shape[-1]
+        ins_k = jnp.clip(off - 1, 0, K - 1)
+        b = jnp.where(off == 0, base_r[src_c], insb_r[src_c, ins_k])
+        q = phred_r[src_c]
+        return b, q
+
+    nb, nq = jax.vmap(row)(cum, call.base.astype(jnp.int32),
+                           call.ins_bases.astype(jnp.int32),
+                           call.phred.astype(jnp.int32))
+    live = p[None, :] < new_len[:, None]
+    new_codes = jnp.where(live, nb, 4).astype(jnp.int8)
+    new_qual = jnp.where(live, nq, 0).astype(jnp.uint8)
+    return new_codes, new_qual, new_len
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def device_hcr_mask(qual: jnp.ndarray, lengths: jnp.ndarray, p: MaskParams):
+    """On-device twin of pipeline/masking.py:hcr_intervals/mask_batch.
+    Returns (mask bool [B, L], masked_frac scalar)."""
+    B, L = qual.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    valid = pos < lengths[:, None]
+    q = qual.astype(jnp.int32)
+    inq = (q >= p.phred_min) & (q <= p.phred_max) & valid
+
+    def runs(mask):
+        """per-position (start, end) of the containing True run."""
+        # start[i] = max j<=i with mask[j-1] False (0 if none)
+        brk = jnp.where(~mask, pos + 1, 0)
+        start = jax.lax.associative_scan(jnp.maximum, brk, axis=1)
+        brk_r = jnp.where(~mask, L - pos, 0)
+        end_r = jax.lax.associative_scan(jnp.maximum, brk_r, axis=1,
+                                         reverse=True)
+        end = L - end_r
+        return start, end
+
+    s1, e1 = runs(inq)
+    kept = inq & ((e1 - s1) >= p.mask_min_len)
+
+    # merge gaps < unmask_min_len that lie strictly between kept runs
+    gap = (~kept) & valid
+    gs, ge = runs(gap)
+    has_left = jax.lax.associative_scan(
+        jnp.logical_or, kept, axis=1)
+    has_right = jax.lax.associative_scan(
+        jnp.logical_or, kept, axis=1, reverse=True)
+    # a gap run merges only if bounded by kept runs within the read
+    gap_len = ge - gs
+    left_in = jnp.where(gs > 0, jnp.take_along_axis(
+        has_left, jnp.maximum(gs - 1, 0), axis=1), False)
+    right_ok = (ge < lengths[:, None]) & jnp.take_along_axis(
+        has_right, jnp.clip(ge, 0, L - 1), axis=1)
+    fill = gap & (gap_len < p.unmask_min_len) & left_in & right_ok
+    merged = kept | fill
+
+    # boundary reduction on merged runs
+    ms, me = runs(merged)
+    red = p.mask_reduce
+    end_red = int(round(p.mask_reduce * p.end_ratio))
+    lo = ms + jnp.where(ms == 0, end_red, red)
+    hi = me - jnp.where(me == lengths[:, None], end_red, red)
+    final = merged & (pos >= lo) & (pos < hi)
+
+    total = jnp.maximum(jnp.sum(lengths), 1)
+    frac = jnp.sum(final) / total
+    return final, frac
+
+
+# --------------------------------------------------------------------------
+# one correction pass
+# --------------------------------------------------------------------------
+
+@dataclass
+class DevicePassStats:
+    n_candidates: int = 0
+    n_admitted: int = 0
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("m", "W", "interpret", "ap"),
+)
+def _gather_and_align(map_flat, q_codes, rc_codes, q_qual, q_lengths,
+                      sread, strand, lread, diag, L,
+                      m: int, W: int, ap: AlignParams,
+                      ignore_flat=None, interpret: bool = False):
+    """One chunk: gather query/window slabs, run the bsw kernel, build the
+    (pre-admission) vote slabs and per-candidate stats."""
+    n = m + W
+    R = sread.shape[0]
+
+    q = jnp.where(strand[:, None] == 0, q_codes[sread], rc_codes[sread])
+    qual_f = q_qual[sread]
+    qual_r = device_reverse_rows(qual_f, q_lengths[sread])
+    qual = jnp.where(strand[:, None] == 0, qual_f, qual_r)
+    qlen = q_lengths[sread]
+
+    win_start = diag - W // 2
+    idx = win_start[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
+    inb = (idx >= 0) & (idx < L)
+    flat_idx = lread[:, None] * L + jnp.clip(idx, 0, L - 1)
+    win = jnp.where(inb, map_flat[flat_idx], 4).astype(jnp.int8)
+
+    res = bsw.bsw_expand(q.astype(jnp.int8), win, qlen, ap,
+                         interpret=interpret)
+
+    thr = (ap.min_out_score * qlen.astype(jnp.float32)
+           if ap.score_per_base else ap.min_out_score)
+    passed = res.valid & (res.score >= thr)
+
+    ignore_cols = None
+    if ignore_flat is not None:
+        ignore_cols = jnp.where(inb, ignore_flat[flat_idx], False)
+
+    span = res.r_end - res.r_start
+    pos0 = win_start + res.r_start
+    return res, q, qual, win_start, passed, pos0, span, ignore_cols
+
+
+class DeviceCorrector:
+    """Chunked device correction over one long-read batch state."""
+
+    def __init__(self, chunk: int = 8192, interpret: Optional[bool] = None):
+        self.chunk = chunk
+        self.interpret = (bsw.default_interpret() if interpret is None
+                          else interpret)
+
+    def correct_pass(
+        self,
+        codes, qual, lengths,          # device [B, Lp] i8 / u8, [B] i32
+        mask_cols,                     # device bool [B, Lp] or None
+        q_codes, rc_codes, q_qual, q_lengths,   # device query batch
+        ap: AlignParams, cns: ConsensusParams,
+        use_mask_as_ignore: bool = True,
+        seed_stride: int = 8, seed_min_votes: int = 2,
+    ) -> Tuple[ConsensusCall, DevicePassStats]:
+        B, Lp = codes.shape
+        m = q_codes.shape[1]
+        W = bsw.band_lanes(ap)
+        n = m + W
+
+        if mask_cols is not None:
+            map_codes = jnp.where(mask_cols, jnp.int8(N), codes)
+        else:
+            map_codes = codes
+        index = dseed.device_index(map_codes, lengths, ap.min_seed_len)
+        cand = dseed.probe_candidates(
+            index, q_codes, q_lengths, rc_codes, ap,
+            stride=seed_stride, min_votes=seed_min_votes)
+        sread, strand, lread, diag, n_valid = dseed.compact_candidates(cand)
+        n_cand = int(n_valid)                       # host sync #1
+
+        map_flat = map_codes.reshape(-1)
+        ignore_flat = None
+        if use_mask_as_ignore and mask_cols is not None:
+            ignore_flat = mask_cols.reshape(-1)
+
+        CH = self.chunk
+        n_chunks = max(1, -(-n_cand // CH))
+        pad = n
+        Lpile = Lp + 2 * n
+        pileup = jnp.zeros((B, Lpile, PACK_LANES), jnp.float32)
+
+        chunks = []
+        for c in range(n_chunks):
+            sl = slice(c * CH, (c + 1) * CH)
+            res, q, qq, win_start, passed, pos0, span, ign = \
+                _gather_and_align(
+                    map_flat, q_codes, rc_codes, q_qual, q_lengths,
+                    sread[sl], strand[sl].astype(jnp.int32), lread[sl],
+                    diag[sl], Lp, m=m, W=W, ap=ap,
+                    ignore_flat=ignore_flat, interpret=self.interpret)
+            live = (jnp.arange(sl.start, sl.start + CH) < n_cand)
+            chunks.append((res, q, qq, win_start, passed & live, pos0, span,
+                           ign, sl))
+
+        all_passed = jnp.concatenate([c[4] for c in chunks])
+        all_pos0 = jnp.concatenate([c[5] for c in chunks])
+        all_span = jnp.concatenate([c[6] for c in chunks])
+        all_score = jnp.concatenate([c[0].score for c in chunks])
+        R_tot = all_passed.shape[0]
+        admitted = device_admit(
+            lread[:R_tot], all_pos0, all_span, all_score, all_passed,
+            lengths, cns)
+
+        for (res, q, qq, win_start, passed, pos0, span, ign, sl) in chunks:
+            keep = admitted[sl.start:sl.start + CH]
+            votes = build_votes(
+                res.state, res.qrow, res.ins_len, q, qq,
+                res.q_start, res.q_end, keep,
+                ignore_cols=ign,
+                qual_weighted=cns.qual_weighted,
+                taboo_frac=cns.indel_taboo if cns.trim else 0.0,
+                taboo_abs=(cns.indel_taboo_length or 0) if cns.trim else 0,
+                min_aln_length=cns.min_aln_length)
+            w0p = jnp.clip(win_start + pad, 0, Lpile - n)
+            pileup = pileup_accumulate(
+                pileup, votes, lread[sl], w0p, interpret=self.interpret)
+
+        pile = unpack_pileup(pileup, pad, Lp)
+        if cns.use_ref_qual:
+            pos = jnp.arange(Lp, dtype=jnp.int32)[None, :]
+            lmask = (pos < lengths[:, None]).astype(jnp.float32)
+            pile = add_ref_votes(pile, codes, qual.astype(jnp.float32), lmask)
+
+        call = call_consensus(pile, codes, cns.max_ins_length)
+        stats = DevicePassStats(n_candidates=n_cand,
+                                n_admitted=int(admitted.sum()))
+        return call, stats
