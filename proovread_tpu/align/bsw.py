@@ -256,6 +256,10 @@ def bsw_expand(q, win, qlen, params: AlignParams,
     """
     R, m = q.shape
     W = band_lanes(params)
+    # the end-cell payload packs the lane index into 7 bits ((r << 7) |
+    # iota_w, decoded with & 127) and the dirs word carries the deletion
+    # origin lane in 8 bits — wider bands would silently corrupt traceback
+    assert W <= 128, f"band_lanes({params.band_width}) = {W} > 128 lanes"
     n = m + W
     assert win.shape == (R, n), (win.shape, (R, n))
     C = _block_candidates(m)

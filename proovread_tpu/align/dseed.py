@@ -138,8 +138,14 @@ def _probe(index_kmers, index_gpos, q_codes, q_lengths, rc_codes,
 
     mean_diag = (dsum + votes // 2) // jnp.maximum(votes, 1)
     neg_rank = jnp.where(live, -votes, 1 << 30)
+    # dead slots (duplicate occurrences, sub-min_votes clusters) must not
+    # leak through the key_top < INVALID check below as phantom candidates:
+    # mask their keys to INVALID before ranking
+    keys_m = jnp.where(live, keys, INVALID)
+    diag_m = jnp.where(live, mean_diag, 0)
+    votes_m = jnp.where(live, votes, 0)
     _, key_s, diag_s, votes_s = jax.lax.sort(
-        [neg_rank, keys, mean_diag, votes], num_keys=1, dimension=-1)
+        [neg_rank, keys_m, diag_m, votes_m], num_keys=1, dimension=-1)
     key_top = key_s[..., :slots]
     lread = jnp.where(key_top < INVALID, key_top // DQ_SPAN, -1)
     return DeviceCandidates(

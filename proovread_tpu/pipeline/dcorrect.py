@@ -263,6 +263,7 @@ class DeviceCorrector:
     """Chunked device correction over one long-read batch state."""
 
     def __init__(self, chunk: int = 8192, interpret: Optional[bool] = None):
+        assert chunk % 128 == 0, "chunk must be a multiple of the bsw block"
         self.chunk = chunk
         self.interpret = (bsw.default_interpret() if interpret is None
                           else interpret)
@@ -299,6 +300,21 @@ class DeviceCorrector:
 
         CH = self.chunk
         n_chunks = max(1, -(-n_cand // CH))
+        # every chunk slice must have exactly CH rows (bsw_expand asserts
+        # R % block == 0); pad the candidate arrays when the slot count is
+        # not a chunk multiple. Pad lreads repeat the last row so read_of
+        # stays sorted for the pileup kernel; pad rows are dead (>= n_cand).
+        R_need = n_chunks * CH
+        R0 = sread.shape[0]
+        if R_need > R0:
+            padn = R_need - R0
+            sread = jnp.concatenate(
+                [sread, jnp.zeros(padn, sread.dtype)])
+            strand = jnp.concatenate(
+                [strand, jnp.zeros(padn, strand.dtype)])
+            lread = jnp.concatenate(
+                [lread, jnp.broadcast_to(lread[-1], (padn,))])
+            diag = jnp.concatenate([diag, jnp.zeros(padn, diag.dtype)])
         pad = n
         Lpile = Lp + 2 * n
         pileup = jnp.zeros((B, Lpile, PACK_LANES), jnp.float32)
