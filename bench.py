@@ -3,12 +3,18 @@
 Config #1 of BASELINE.json: the bundled 121 long reads (126,422 bp) corrected
 with ~30x simulated 100bp short reads (the sample's short-read blob is
 missing upstream, `.MISSING_LARGE_BLOBS:1`; reads are simulated from the
-bundled genome at 1% error, as SURVEY §7.3 prescribes).
+bundled genome at 0.5% error, as SURVEY §7.3 prescribes).
 
-Baseline: the reference publishes exactly one end-to-end wall-clock — 315.5Mb
-corrected in ~59min on a 2015 ~20-core server (`README.org:193-204,277-279`)
-— i.e. ~89,000 corrected bases/sec for the whole CPU pipeline. BASELINE.json
-targets >=20x that on one v5e chip.
+What is timed: one full ``Pipeline.run`` — the iterative product (mapping +
+consensus iterations, device HCR masking, mask shortcut, finish pass with
+chimera detection, final trim), on the device engine. A first run warms the
+XLA compile cache; the second is timed, matching the reference baseline's
+steady-state regime (its 89k bases/sec comes from a 315.5Mb workload where
+startup cost is amortized, `README.org:193-204,277-279`).
+
+Accuracy: true alignment identity (matches / max(len_corrected, len_true)),
+computed for EVERY corrected read against the bundled error-free originals
+via full SW traceback — not a score proxy.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -22,21 +28,50 @@ import numpy as np
 BASELINE_BASES_PER_SEC = 89_000.0  # README.org:193-204: 315.5e6 bases / 59 min
 
 
+def true_identity(pairs):
+    """pairs: [(corrected_codes, orig_codes)]. Returns per-pair identity:
+    SW-aligned match count / max(len). Batched on device."""
+    import jax.numpy as jnp
+    from proovread_tpu.align.params import AlignParams
+    from proovread_tpu.align.sw import sw_batch
+
+    loose = AlignParams(clip=0, score_per_base=False, min_out_score=0)
+    P = max(max(len(a), len(b)) for a, b in pairs)
+    P = ((P + 127) // 128) * 128 + 128
+    R = len(pairs)
+    q = np.full((R, P), 4, np.int8)
+    r = np.full((R, P), 4, np.int8)
+    qlen = np.zeros(R, np.int32)
+    for i, (a, b) in enumerate(pairs):
+        q[i, :len(a)] = a
+        r[i, :len(b)] = b
+        qlen[i] = len(a)
+    res = sw_batch(jnp.asarray(q), jnp.asarray(r), jnp.asarray(qlen), loose)
+    ops_rev = np.asarray(res.ops_rev)
+    step_i = np.asarray(res.step_i)
+    step_j = np.asarray(res.step_j)
+    out = []
+    for i, (a, b) in enumerate(pairs):
+        ops = ops_rev[i]
+        m_steps = ops == 0
+        qi = step_i[i][m_steps].astype(np.int64) - 1
+        rj = step_j[i][m_steps].astype(np.int64) - 1
+        ok = (qi >= 0) & (qi < len(a)) & (rj >= 0) & (rj < len(b))
+        matches = int((a[qi[ok]] == b[rj[ok]]).sum())
+        out.append(matches / max(len(a), len(b), 1))
+    return out
+
+
 def main():
     import jax
     # persistent compile cache: steady-state numbers, not XLA compile time
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
-    from proovread_tpu.align.params import AlignParams
-    from proovread_tpu.align.sw import sw_batch
-    from proovread_tpu.consensus.params import ConsensusParams
     from proovread_tpu.io import fasta, fastq
-    from proovread_tpu.io.batch import pack_reads
     from proovread_tpu.io.records import SeqRecord
     from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
-    from proovread_tpu.pipeline import FastCorrector
-    import jax.numpy as jnp
+    from proovread_tpu.pipeline import Pipeline, PipelineConfig
 
     sample = "/root/reference/sample"
     rng = np.random.default_rng(0)
@@ -48,53 +83,40 @@ def main():
     for i in range(30 * G // 100):
         st = int(rng.integers(0, G - 100))
         seq = genome[st:st + 100].copy()
-        for mu in np.flatnonzero(rng.random(100) < 0.01):
+        for mu in np.flatnonzero(rng.random(100) < 0.005):
             seq[mu] = (seq[mu] + 1 + rng.integers(0, 3)) % 4
         if rng.random() < 0.5:
             seq = revcomp_codes(seq)
         srs.append(SeqRecord(f"s{i}", decode_codes(seq),
                              qual=np.full(100, 30, np.uint8)))
-    sr = pack_reads(srs)
 
     longs = list(fastq.FastqReader(f"{sample}/F.antasticus_long_error.fq"))
-    # pad the batch to a fixed bucket so every run compiles the same shapes
-    B_bucket = ((len(longs) + 31) // 32) * 32
-    dummies = [SeqRecord(f"_pad{i}", "A" * 8)
-               for i in range(B_bucket - len(longs))]
-    lr = pack_reads(longs + dummies)
-    total_bases = int(lr.lengths[:len(longs)].sum())
+    total_bases = sum(len(r) for r in longs)
 
-    fc = FastCorrector(
-        cns_params=ConsensusParams(qual_weighted=True, use_ref_qual=True))
+    def run_once():
+        pipe = Pipeline(PipelineConfig(mode="sr", n_iterations=6,
+                                       sampling=True, engine="device"))
+        return pipe.run(longs, srs)
 
-    # warmup with identical shapes (first call pays XLA compiles)
-    fc.correct_batch(lr, sr)
-
+    run_once()                      # warm the compile cache
     t0 = time.time()
-    out, stats = fc.correct_batch(lr, sr)
+    res = run_once()
     dt = time.time() - t0
     bases_per_sec = total_bases / dt
 
-    # accuracy spot check vs the bundled error-free originals
-    origs = {r.id.split("_")[2]: r
+    origs = {r.id.split("_")[2]: encode_ascii(r.seq)
              for r in fastq.FastqReader(f"{sample}/F.antasticus_long_orig.fq")}
-    loose = AlignParams(clip=0, score_per_base=False, min_out_score=0)
-
-    def ident(a, b):
-        pad = ((max(len(a), len(b)) + 127) // 128) * 128 + 128
-        qp = np.full(pad, 4, np.int8); qp[:len(a)] = a
-        rp = np.full(pad, 4, np.int8); rp[:len(b)] = b
-        r = sw_batch(jnp.asarray(qp[None]), jnp.asarray(rp[None]),
-                     jnp.asarray([len(a)], np.int32), loose)
-        return float(r.score[0]) / (5 * len(b))
-
-    idents = []
-    for i in range(0, len(longs), 12):
-        key = longs[i].id.split("_")[2] if longs[i].id.startswith("long_error_") else None
+    corrected = {r.id: r for r in res.untrimmed}
+    pairs_before, pairs_after = [], []
+    for rec_in in longs:
+        rec_out = corrected[rec_in.id]
+        key = (rec_in.id.split("_")[2]
+               if rec_in.id.startswith("long_error_") else None)
         if key and key in origs:
-            idents.append(ident(encode_ascii(out[i].record.seq),
-                                encode_ascii(origs[key].seq)))
-    mean_ident = float(np.mean(idents)) if idents else 0.0
+            pairs_before.append((encode_ascii(rec_in.seq), origs[key]))
+            pairs_after.append((encode_ascii(rec_out.seq), origs[key]))
+    id_before = float(np.mean(true_identity(pairs_before)))
+    id_after = float(np.mean(true_identity(pairs_after)))
 
     print(json.dumps({
         "metric": "corrected_bases_per_sec_per_chip",
@@ -103,8 +125,11 @@ def main():
         "vs_baseline": round(bases_per_sec / BASELINE_BASES_PER_SEC, 3),
         "wall_s": round(dt, 2),
         "n_reads": len(longs),
-        "n_candidates": stats.n_candidates,
-        "mean_identity_vs_orig": round(mean_ident, 4),
+        "n_passes": len(res.reports),
+        "masked_final": round(res.reports[-2].masked_frac, 3)
+        if len(res.reports) > 1 else None,
+        "identity_before": round(id_before, 4),
+        "identity_after": round(id_after, 4),
     }))
 
 

@@ -29,12 +29,26 @@ import jax.numpy as jnp
 from proovread_tpu.align.params import AlignParams
 
 
+# direct-address bucket table: buckets are the top TABLE_BASES bases of the
+# k-mer (the whole k-mer when k <= TABLE_BASES). Sorted k-mers group bucket
+# prefixes contiguously, so [starts[b], starts[b] + counts[b]) is the hit
+# range — O(1) lookups instead of a log M searchsorted whose gather chain
+# dominated the seeding cost on TPU.
+TABLE_BASES = 12
+
+
 class DeviceIndex(NamedTuple):
     kmers: jnp.ndarray   # u32 [M] sorted k-mer values (0xFFFFFFFF = invalid)
     gpos: jnp.ndarray    # i32 [M] read * L + offset
+    starts: jnp.ndarray  # i32 [T + 1] bucket start in the sorted table
+    counts: jnp.ndarray  # i32 [T + 1] bucket occurrence count
     k: int
     length: int          # L of the indexed batch
     n_reads: int
+
+    @property
+    def shift(self) -> int:
+        return 2 * max(self.k - TABLE_BASES, 0)
 
 
 class DeviceCandidates(NamedTuple):
@@ -62,7 +76,7 @@ def _rolling_kmers(codes: jnp.ndarray, lengths: jnp.ndarray, k: int):
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def build_index(codes: jnp.ndarray, lengths: jnp.ndarray, k: int):
-    """Sorted k-mer table of a long-read batch (device)."""
+    """Sorted k-mer table + direct-address bucket table (device)."""
     B, L = codes.shape
     vals, valid = _rolling_kmers(codes, lengths, k)
     n_pos = vals.shape[1]
@@ -71,22 +85,33 @@ def build_index(codes: jnp.ndarray, lengths: jnp.ndarray, k: int):
     gpos = (jnp.arange(B, dtype=jnp.int32)[:, None] * L + pos)
     gpos = jnp.broadcast_to(gpos, vals.shape).reshape(-1)
     skeys, sgpos = jax.lax.sort([keys, gpos], num_keys=1)
-    return skeys, sgpos
+
+    t = min(k, TABLE_BASES)
+    shift = 2 * (k - t)
+    T = 4 ** t
+    bucket = jnp.minimum(skeys >> shift, jnp.uint32(T)).astype(jnp.int32)
+    counts = jnp.zeros(T + 1, jnp.int32).at[bucket].add(1)
+    starts = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
+    return skeys, sgpos, starts, counts
 
 
 def device_index(codes, lengths, k: int) -> DeviceIndex:
     B, L = codes.shape
-    skeys, sgpos = build_index(codes, lengths, k)
-    return DeviceIndex(kmers=skeys, gpos=sgpos, k=k, length=L, n_reads=B)
+    skeys, sgpos, starts, counts = build_index(codes, lengths, k)
+    return DeviceIndex(kmers=skeys, gpos=sgpos, starts=starts, counts=counts,
+                       k=k, length=L, n_reads=B)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "stride", "occ_cap", "slots", "quant",
-                     "max_occ", "min_votes"),
+                     "max_occ", "min_votes", "shift"),
 )
-def _probe(index_kmers, index_gpos, q_codes, q_lengths, rc_codes,
-           *, k, L, stride, occ_cap, slots, quant, max_occ, min_votes):
+def _probe(index_kmers, index_gpos, index_starts, index_counts,
+           q_codes, q_lengths, rc_codes,
+           *, k, L, stride, occ_cap, slots, quant, max_occ, min_votes,
+           shift):
     Bq, m = q_codes.shape
     probes = []
     for strand, qc in ((0, q_codes), (1, rc_codes)):
@@ -97,14 +122,19 @@ def _probe(index_kmers, index_gpos, q_codes, q_lengths, rc_codes,
 
     INVALID = jnp.int32(1 << 29)
     DQ_SPAN = (L + m) // quant + 2
+    T = index_starts.shape[0] - 1
 
     keys_all, diags_all = [], []
     for strand in (0, 1):
         vals, valid, ps = probes[strand]
         flat = jnp.where(valid, vals, jnp.uint32(0xFFFFFFFE)).reshape(-1)
-        lo = jnp.searchsorted(index_kmers, flat, side="left").astype(jnp.int32)
-        hi = jnp.searchsorted(index_kmers, flat, side="right").astype(jnp.int32)
-        occ = hi - lo
+        # direct-address bucket lookup (invalid probes are gated by `valid`;
+        # with shift > 0 occ counts the prefix bucket and hits verify the
+        # full k-mer below — the max_occ repeat cap then acts per prefix,
+        # a documented deviation of the same sensitivity-heuristic class)
+        pk = jnp.minimum(flat >> shift, jnp.uint32(T)).astype(jnp.int32)
+        lo = index_starts[pk]
+        occ = index_counts[pk]
         use = valid.reshape(-1) & (occ > 0) & (occ <= max_occ)
         occ_use = jnp.minimum(occ, occ_cap)
         hit_keys, hit_diags = [], []
@@ -119,6 +149,8 @@ def _probe(index_kmers, index_gpos, q_codes, q_lengths, rc_codes,
             dq = (diag + m) // quant
             key = lread * DQ_SPAN + dq
             ok = use & (j < occ_use)
+            if shift > 0:
+                ok &= index_kmers[idx] == flat
             hit_keys.append(jnp.where(ok, key, INVALID))
             hit_diags.append(jnp.where(ok, diag, 0))
         keys_all.append(jnp.stack(hit_keys, -1).reshape(Bq, P * occ_cap))
@@ -167,10 +199,11 @@ def probe_candidates(
 ) -> DeviceCandidates:
     quant = max(params.band_width // 2, 1)
     return _probe(
-        index.kmers, index.gpos, q_codes, q_lengths, rc_codes,
+        index.kmers, index.gpos, index.starts, index.counts,
+        q_codes, q_lengths, rc_codes,
         k=index.k, L=index.length, stride=stride, occ_cap=occ_cap,
         slots=params.max_candidates, quant=quant, max_occ=params.max_occ,
-        min_votes=min_votes,
+        min_votes=min_votes, shift=index.shift,
     )
 
 

@@ -22,7 +22,75 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from proovread_tpu.ops.votes import PACK_LANES
+from proovread_tpu.ops.votes import INS_CAP, PACK_LANES
+
+
+def _accum_packed_kernel(read_of_ref, w0_ref, pile_in_ref, packed_ref,
+                         pile_out_ref, *, n):
+    """Decode one candidate's packed i32 vote words (ops/votes.py:
+    encode_votes layout) into the [n, PACK_LANES] slab in VMEM and add."""
+    i = pl.program_id(0)
+    w0 = w0_ref[i]
+    first = jnp.logical_or(i == 0, read_of_ref[i] != read_of_ref[i - 1])
+
+    @pl.when(first)
+    def _():
+        pile_out_ref[0] = pile_in_ref[0]
+
+    word = packed_ref[0, 0]                           # [n] i32
+    w = word[:, None]                                 # [n, 1]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (n, PACK_LANES), 1)
+
+    st_f = w & 7                                      # 0 none, else state+1
+    votes = (lanes == (st_f - 1)) & (st_f > 0)
+    votes |= (lanes == (8 + st_f - 1)) & (((w >> 3) & 1) > 0) & (st_f > 0)
+    len_f = (w >> 4) & 7                              # 0 none, else bucket+1
+    votes |= (lanes == (16 + len_f - 1)) & (len_f > 0)
+    for k in range(INS_CAP):
+        b_f = (w >> (7 + 3 * k)) & 7                  # 5 = none
+        # len_f > 0 also rejects all-zero (admission-zeroed / pad) words,
+        # whose b_f of 0 would otherwise read as base-A votes
+        votes |= (lanes == (24 + 5 * k + b_f)) & (b_f < 5) & (len_f > 0)
+
+    pile_out_ref[0, pl.ds(w0, n), :] += votes.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pileup_accumulate_packed(
+    pileup_packed: jnp.ndarray,   # f32 [B, Lp, PACK_LANES]
+    words: jnp.ndarray,           # i32 [R, n] packed vote words
+    read_of: jnp.ndarray,         # i32 [R] sorted ascending
+    w0: jnp.ndarray,              # i32 [R] padded window offset
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Packed-vote twin of :func:`pileup_accumulate`: rows of ``words`` for
+    dead candidates must be all-zero (an all-zero word decodes to no votes)."""
+    B, Lp, P = pileup_packed.shape
+    R, n = words.shape
+    assert P == PACK_LANES
+    # leading singleton so the TPU block-shape rule sees (1, n) == array dims
+    words3 = words.reshape(R, 1, n)
+
+    grid = (R,)
+    kernel = functools.partial(_accum_packed_kernel, n=n)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, Lp, P), lambda i, ro, w: (ro[i], 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, n), lambda i, ro, w: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, Lp, P), lambda i, ro, w: (ro[i], 0, 0),
+                                   memory_space=pltpu.VMEM),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Lp, P), jnp.float32),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(read_of, w0, pileup_packed, words3)
 
 
 def _accum_kernel(read_of_ref, w0_ref, pile_in_ref, votes_ref, pile_out_ref,

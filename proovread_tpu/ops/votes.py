@@ -154,6 +154,92 @@ def build_votes(
     return packed
 
 
+# --------------------------------------------------------------------------
+# packed single-word votes (the unweighted fast path)
+# --------------------------------------------------------------------------
+#
+# With uniform vote weights every column's whole vote content fits one i32:
+#   bits 0-2:  plain-state field: 0 = no vote, else state+1 (1..6)
+#   bit  3:    has-insertion marker (lane 8+state)
+#   bits 4-6:  insertion length field: 0 = none, else min(eff_len, K) (1..6)
+#   bits 7-24: six 3-bit inserted-base codes (offsets 0..5; 5 = none)
+# The Pallas pileup kernel decodes the word back into the PACK_LANES slab in
+# VMEM (ops/pileup_kernel.py:pileup_accumulate_packed), so the [R, n, 64]
+# vote tensor never exists in HBM — build_votes at ~1/64th the traffic.
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("taboo_frac", "taboo_abs", "min_aln_length"),
+)
+def encode_votes(
+    state: jnp.ndarray,     # i32 [R, n] window-col state (-1 = none)
+    qrow: jnp.ndarray,      # i32 [R, n] consuming query row
+    ins_len: jnp.ndarray,   # i32 [R, n] inserted bases after the col
+    q: jnp.ndarray,         # i32/i8 [R, m] query codes (strand-oriented)
+    q_start: jnp.ndarray,   # i32 [R]
+    q_end: jnp.ndarray,     # i32 [R]
+    ignore_cols: jnp.ndarray | None = None,  # bool [R, n] MCR columns
+    taboo_frac: float = 0.1,
+    taboo_abs: int = 0,
+    min_aln_length: int = 50,
+) -> jnp.ndarray:
+    """Packed i32 vote words [R, n]. Admission is NOT applied here — zero
+    rejected rows before the pileup kernel. Mirrors build_votes' gating
+    (same 1D1I rewrite, taboo masking, length-gate semantics) for the
+    uniform-weight case."""
+    R, n = state.shape
+    m = q.shape[1]
+    K = INS_CAP
+    q = q.astype(jnp.int32)
+
+    aln_len = q_end - q_start
+    if taboo_abs:
+        taboo = jnp.full((R,), taboo_abs, jnp.int32)
+    else:
+        taboo = jnp.floor(aln_len * taboo_frac + 0.5).astype(jnp.int32)
+    kept_lo = q_start + taboo
+    kept_hi = q_end - taboo
+    ok = (
+        (aln_len > min_aln_length)
+        & ((kept_hi - kept_lo) >= min_aln_length)
+        & ((kept_hi - kept_lo) >= 0.7 * aln_len)
+    )
+
+    gapins = (state == GAP) & (ins_len > 0)
+    qrow = jnp.where(gapins, qrow + 1, qrow)
+    base_at = jnp.take_along_axis(q, jnp.clip(qrow, 0, m - 1), axis=1)
+    state = jnp.where(gapins, base_at, state)
+    ins_len = jnp.where(gapins, ins_len - 1, ins_len)
+
+    has_state = state >= 0
+    in_keep = (qrow >= kept_lo[:, None]) & (qrow < kept_hi[:, None])
+    col_ok = ok[:, None]
+    if ignore_cols is not None:
+        col_ok = col_ok & ~ignore_cols
+    live = has_state & in_keep & col_ok
+
+    st = jnp.clip(state, 0, N_STATES - 1)
+    word = jnp.where(live, st + 1, 0)
+
+    first_qi = qrow + 1
+    k0 = jnp.clip(kept_lo[:, None] - first_qi, 0, 1 << 20)
+    kept_len = jnp.minimum(ins_len, kept_hi[:, None] - first_qi)
+    eff_len = jnp.clip(kept_len - k0, 0, 1 << 20)
+    eff_live = col_ok & (ins_len > 0) & (eff_len > 0)
+
+    word |= jnp.where(live & (state != GAP) & eff_live & (k0 == 0), 8, 0)
+    word |= jnp.where(eff_live, jnp.minimum(eff_len, K), 0) << 4
+
+    for k in range(K):
+        qi_k = jnp.clip(first_qi + k0 + k, 0, m - 1)
+        b_k = jnp.take_along_axis(q, qi_k, axis=1)
+        b_field = jnp.where(eff_live & (k < eff_len),
+                            jnp.clip(b_k, 0, 4), 5)
+        word |= b_field << (7 + 3 * k)
+
+    return word
+
+
 def unpack_pileup(pileup_packed: jnp.ndarray, pad: int, length: int):
     """Packed [B, pad + L + pad, PACK_LANES] -> Pileup tensors."""
     from proovread_tpu.ops.pileup import Pileup

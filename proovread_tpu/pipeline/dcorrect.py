@@ -42,8 +42,10 @@ from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.ops.consensus_call import ConsensusCall, call_consensus
 from proovread_tpu.ops.encode import N
 from proovread_tpu.ops.fused import add_ref_votes
-from proovread_tpu.ops.pileup_kernel import pileup_accumulate
-from proovread_tpu.ops.votes import PACK_LANES, build_votes, unpack_pileup
+from proovread_tpu.ops.pileup_kernel import (pileup_accumulate,
+                                             pileup_accumulate_packed)
+from proovread_tpu.ops.votes import (PACK_LANES, build_votes, encode_votes,
+                                     unpack_pileup)
 from proovread_tpu.pipeline.masking import MaskParams
 
 log = logging.getLogger("proovread_tpu")
@@ -214,8 +216,130 @@ def device_hcr_mask(qual: jnp.ndarray, lengths: jnp.ndarray, p: MaskParams):
 
 @dataclass
 class DevicePassStats:
+    """``n_admitted`` may be a device scalar — fetch it together with the
+    iteration KPI to pay one RPC, not two."""
     n_candidates: int = 0
-    n_admitted: int = 0
+    n_admitted: object = 0
+
+
+@dataclass
+class AlnData:
+    """Host-side view of one pass's admitted candidates, for the chimera
+    entropy scan (``bin/bam2cns:461-491``). Expanded column slabs stay on
+    device; ``column_states`` fetches them lazily per chunk."""
+    lread: np.ndarray       # i32 [R]
+    pos0: np.ndarray        # i32 [R]
+    span: np.ndarray        # i32 [R]
+    admitted: np.ndarray    # bool [R] passed threshold + bin admission
+    vote_ok: np.ndarray     # bool [R] passed the state-matrix length gates
+    q_start: np.ndarray     # i32 [R]
+    q_end: np.ndarray       # i32 [R]
+    win_start: np.ndarray   # i32 [R]
+    r_start: np.ndarray     # i32 [R]
+    r_end: np.ndarray       # i32 [R]
+    cns: ConsensusParams
+    state: object           # device i8 [R, n] window-col states (-1 = none)
+    qrow: object            # device i16 [R, n]
+    ins_len: object         # device i16 [R, n]
+    _rows: dict = field(default_factory=dict)
+
+    def prefetch(self, cis) -> None:
+        """Fetch the expanded slabs of the given candidates in ONE gather +
+        transfer (the tunneled fetch path is bandwidth-bound; per-row pulls
+        would pay the RPC latency per candidate)."""
+        cis = [int(c) for c in cis if int(c) not in self._rows]
+        if not cis:
+            return
+        idx = jnp.asarray(np.asarray(cis, np.int32))
+        st, qr, il = jax.device_get(
+            (self.state[idx], self.qrow[idx], self.ins_len[idx]))
+        for j, ci in enumerate(cis):
+            self._rows[ci] = (st[j], qr[j], il[j])
+
+    def column_states(self, ci: int):
+        """Expanded :class:`ColumnStates` of candidate ``ci`` (or None),
+        taboo-trimmed with the same per-column gate as ``build_votes``.
+        Insertion-base identities are not reconstructed (the chimera scan
+        only consumes state counts and has-insertion flags)."""
+        from proovread_tpu.consensus.cigar import ColumnStates
+
+        ci = int(ci)
+        if ci not in self._rows:
+            self.prefetch([ci])
+        st, qr, il = self._rows[ci]
+        cns = self.cns
+        aln_len = int(self.q_end[ci] - self.q_start[ci])
+        taboo = (cns.indel_taboo_length if cns.indel_taboo_length
+                 else int(aln_len * cns.indel_taboo + 0.5))
+        kept_lo = self.q_start[ci] + taboo
+        kept_hi = self.q_end[ci] - taboo
+        live = (st >= 0) & (qr >= kept_lo) & (qr < kept_hi)
+        idx = np.flatnonzero(live)
+        if idx.size == 0:
+            return None
+        a, b = int(idx[0]), int(idx[-1]) + 1
+        span = b - a
+        K = cns.ins_cap
+        return ColumnStates(
+            rpos=int(self.win_start[ci]) + a,
+            state=np.clip(st[a:b], 0, None).astype(np.int8),
+            freq=np.ones(span, np.float32),
+            ins_len=np.clip(il[a:b], 0, K).astype(np.int16),
+            ins_bases=np.zeros((span, K), np.int8),
+        )
+
+
+def detect_chimera_device(results, ref_lens: np.ndarray, aln: AlnData) -> None:
+    """Chimera scan over a device pass's admitted candidates — the device-path
+    twin of ``FastCorrector._detect_chimera`` (same ``chimera_scan`` core,
+    ``Sam/Seq.pm:774-888``). Fills each ``results[b].chimera``."""
+    from proovread_tpu.consensus.engine import chimera_scan
+
+    cns = aln.cns
+    bs = cns.bin_size
+    use = aln.admitted & aln.vote_ok
+    adm_idx = np.flatnonzero(use)
+    if adm_idx.size == 0:
+        return
+    span = aln.span
+    pos0 = aln.pos0
+    bins = np.clip(((pos0 + 1 + span / 2) // bs).astype(np.int64), 0, None)
+
+    # quick bin screen first, so one batched prefetch covers every read
+    # that will actually be scanned
+    screened = []
+    for b in range(len(results)):
+        L_i = int(ref_lens[b])
+        mine = adm_idx[aln.lread[adm_idx] == b]
+        if mine.size == 0:
+            continue
+        n_bins = L_i // bs + 1
+        bb = np.bincount(np.clip(bins[mine], 0, n_bins - 1),
+                         weights=span[mine].astype(np.float64),
+                         minlength=n_bins)
+        if n_bins <= 20 or not (bb[5:-5] <= cns.bin_max_bases / 5 + 1).any():
+            continue
+        screened.append((b, L_i, mine, bb))
+    if not screened:
+        return
+    aln.prefetch(np.concatenate([m for _, _, m, _ in screened]))
+
+    for b, L_i, mine, bb in screened:
+        cover = np.zeros(L_i)
+        for ci in mine:
+            a, e = max(0, int(pos0[ci])), min(L_i, int(pos0[ci] + span[ci]))
+            cover[a:e] += 1
+
+        def select(fl, tl, fr, tr, mine=mine):
+            sel_l = [aln.column_states(ci) for ci in mine
+                     if fl <= bins[ci] <= tl]
+            sel_r = [aln.column_states(ci) for ci in mine
+                     if fr <= bins[ci] <= tr]
+            return ([c for c in sel_l if c is not None],
+                    [c for c in sel_r if c is not None])
+
+        results[b].chimera = chimera_scan(bb, L_i, cns, results[b], cover,
+                                          select)
 
 
 @functools.partial(
@@ -276,7 +400,8 @@ class DeviceCorrector:
         ap: AlignParams, cns: ConsensusParams,
         use_mask_as_ignore: bool = True,
         seed_stride: int = 8, seed_min_votes: int = 2,
-    ) -> Tuple[ConsensusCall, DevicePassStats]:
+        collect_aln: bool = False,
+    ):
         B, Lp = codes.shape
         m = q_codes.shape[1]
         W = bsw.band_lanes(ap)
@@ -291,6 +416,10 @@ class DeviceCorrector:
             index, q_codes, q_lengths, rc_codes, ap,
             stride=seed_stride, min_votes=seed_min_votes)
         sread, strand, lread, diag, n_valid = dseed.compact_candidates(cand)
+        try:        # overlap the RPC with the device still seeding
+            n_valid.copy_to_host_async()
+        except AttributeError:
+            pass
         n_cand = int(n_valid)                       # host sync #1
 
         map_flat = map_codes.reshape(-1)
@@ -341,19 +470,30 @@ class DeviceCorrector:
             lread[:R_tot], all_pos0, all_span, all_score, all_passed,
             lengths, cns)
 
+        taboo_frac = cns.indel_taboo if cns.trim else 0.0
+        taboo_abs = (cns.indel_taboo_length or 0) if cns.trim else 0
         for (res, q, qq, win_start, passed, pos0, span, ign, sl) in chunks:
             keep = admitted[sl.start:sl.start + CH]
-            votes = build_votes(
-                res.state, res.qrow, res.ins_len, q, qq,
-                res.q_start, res.q_end, keep,
-                ignore_cols=ign,
-                qual_weighted=cns.qual_weighted,
-                taboo_frac=cns.indel_taboo if cns.trim else 0.0,
-                taboo_abs=(cns.indel_taboo_length or 0) if cns.trim else 0,
-                min_aln_length=cns.min_aln_length)
             w0p = jnp.clip(win_start + pad, 0, Lpile - n)
-            pileup = pileup_accumulate(
-                pileup, votes, lread[sl], w0p, interpret=self.interpret)
+            if cns.qual_weighted:
+                votes = build_votes(
+                    res.state, res.qrow, res.ins_len, q, qq,
+                    res.q_start, res.q_end, keep,
+                    ignore_cols=ign, qual_weighted=True,
+                    taboo_frac=taboo_frac, taboo_abs=taboo_abs,
+                    min_aln_length=cns.min_aln_length)
+                pileup = pileup_accumulate(
+                    pileup, votes, lread[sl], w0p, interpret=self.interpret)
+            else:
+                # packed fast path: one i32 per column, decoded in-kernel
+                words = encode_votes(
+                    res.state, res.qrow, res.ins_len, q,
+                    res.q_start, res.q_end, ignore_cols=ign,
+                    taboo_frac=taboo_frac, taboo_abs=taboo_abs,
+                    min_aln_length=cns.min_aln_length)
+                words = jnp.where(keep[:, None], words, 0)
+                pileup = pileup_accumulate_packed(
+                    pileup, words, lread[sl], w0p, interpret=self.interpret)
 
         pile = unpack_pileup(pileup, pad, Lp)
         if cns.use_ref_qual:
@@ -363,5 +503,33 @@ class DeviceCorrector:
 
         call = call_consensus(pile, codes, cns.max_ins_length)
         stats = DevicePassStats(n_candidates=n_cand,
-                                n_admitted=int(admitted.sum()))
-        return call, stats
+                                n_admitted=admitted.sum())
+        if not collect_aln:
+            return call, stats
+
+        # one host fetch of the per-candidate scalars for the chimera scan
+        h = jax.device_get((
+            lread[:R_tot], all_pos0, all_span, admitted,
+            jnp.concatenate([c[0].q_start for c in chunks]),
+            jnp.concatenate([c[0].q_end for c in chunks]),
+            jnp.concatenate([c[3] for c in chunks]),
+            jnp.concatenate([c[0].r_start for c in chunks]),
+            jnp.concatenate([c[0].r_end for c in chunks]),
+        ))
+        (h_lread, h_pos0, h_span, h_adm, h_qs, h_qe, h_ws, h_rs, h_re) = h
+        aln_len = h_qe - h_qs
+        if cns.indel_taboo_length:
+            taboo = np.full(R_tot, cns.indel_taboo_length, np.int32)
+        else:
+            taboo = np.floor(aln_len * cns.indel_taboo + 0.5).astype(np.int32)
+        kept = (h_qe - taboo) - (h_qs + taboo)
+        vote_ok = ((aln_len > cns.min_aln_length)
+                   & (kept >= cns.min_aln_length)
+                   & (kept >= 0.7 * aln_len))
+        aln = AlnData(
+            lread=h_lread, pos0=h_pos0, span=h_span, admitted=h_adm,
+            vote_ok=vote_ok, q_start=h_qs, q_end=h_qe, win_start=h_ws,
+            r_start=h_rs, r_end=h_re, cns=cns,
+            chunks=[(c[0].state, c[0].qrow, c[0].ins_len) for c in chunks],
+            chunk_size=CH)
+        return call, stats, aln

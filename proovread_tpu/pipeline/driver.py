@@ -20,7 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from proovread_tpu.align.params import AlignParams, BWA_SR, BWA_SR_FINISH, BWA_MR, BWA_MR_1, BWA_MR_FINISH
-from proovread_tpu.consensus.engine import ConsensusResult
+from proovread_tpu.consensus.engine import ConsensusResult, assemble_consensus
 from proovread_tpu.consensus.params import ConsensusParams
 from proovread_tpu.io.batch import ReadBatch, pack_reads
 from proovread_tpu.io.records import SeqRecord
@@ -51,6 +51,13 @@ class PipelineConfig:
     batch_reads: int = 128            # long reads per device batch
     indel_taboo_length: int = 7       # sr-indel-taboo-length
     coverage_scale: float = 0.75      # coverage-scale-factor (proovread.cfg:256)
+    # engine selection: "device" = fully device-resident iteration loop
+    # (Pallas bsw + dseed + pileup kernels, pipeline/dcorrect.py); "scan" =
+    # the host-admission lax.scan fallback (pipeline/correct.py)
+    engine: str = "device"
+    device_chunk: int = 8192          # candidates per bsw kernel launch
+    seed_stride: int = 8              # device-seeder probe stride
+    length_slack: float = 0.2         # Lp headroom for consensus growth
 
 
 @dataclass
@@ -78,6 +85,36 @@ def _align_params(mode: str, iteration: Optional[int]) -> AlignParams:
     if iteration is None:
         return BWA_MR_FINISH
     return BWA_MR_1 if iteration == 1 else BWA_MR
+
+
+class _SrDevice:
+    """Short-read batch resident on device, with a zero-length pad row so
+    per-iteration sampling gathers keep a fixed shape (pad rows form no
+    seeds, hence no candidates)."""
+
+    def __init__(self, sr_all: ReadBatch):
+        import jax.numpy as jnp
+        from proovread_tpu.pipeline.dcorrect import device_revcomp
+
+        m = sr_all.codes.shape[1]
+        codes = np.concatenate([sr_all.codes, np.full((1, m), 4, np.int8)])
+        qual = np.concatenate([sr_all.qual, np.zeros((1, m), np.uint8)])
+        lengths = np.concatenate([sr_all.lengths, np.zeros(1, np.int32)])
+        self.codes = jnp.asarray(codes)
+        self.qual = jnp.asarray(qual)
+        self.lengths = jnp.asarray(lengths)
+        self.rc = device_revcomp(self.codes, self.lengths)
+        self.pad_idx = len(sr_all.lengths)
+
+    def take(self, sel: np.ndarray, pad_multiple: int = 512):
+        import jax.numpy as jnp
+
+        n = len(sel)
+        target = max(pad_multiple, -(-n // pad_multiple) * pad_multiple)
+        idx = np.concatenate(
+            [sel, np.full(target - n, self.pad_idx)]).astype(np.int32)
+        i = jnp.asarray(idx)
+        return self.codes[i], self.rc[i], self.qual[i], self.lengths[i]
 
 
 class Pipeline:
@@ -130,17 +167,142 @@ class Pipeline:
         untrimmed: List[SeqRecord] = []
         results_final: List[ConsensusResult] = []
 
+        sr_dev = None
+        Lp = None
+        if cfg.engine == "device":
+            sr_dev = _SrDevice(sr_all)
+            maxlen = max(len(r) for r in kept)
+            want = int(maxlen * (1 + cfg.length_slack)) + 128
+            Lp = max(128, -(-want // 128) * 128)
+
         for start in range(0, len(kept), cfg.batch_reads):
             batch_recs = kept[start:start + cfg.batch_reads]
-            res_batch, chim = self._run_batch(
-                batch_recs, sr_all, short_records, sampler, coverage,
-                min_sr_len, reports)
+            if cfg.engine == "device":
+                res_batch, chim = self._run_batch_device(
+                    batch_recs, sr_dev, len(short_records), sampler,
+                    coverage, min_sr_len, reports, Lp)
+            else:
+                res_batch, chim = self._run_batch(
+                    batch_recs, sr_all, short_records, sampler, coverage,
+                    min_sr_len, reports)
             results_final.extend(res_batch)
             all_chim.extend(chim)
             untrimmed.extend(r.record for r in res_batch)
 
         trimmed = trim_records(results_final, cfg.trim)
         return PipelineResult(untrimmed, trimmed, ignored, all_chim, reports)
+
+    def _run_batch_device(self, batch_recs, sr_dev, n_short, sampler,
+                          coverage, min_sr_len, reports, Lp):
+        """Device-resident iteration loop: per pass, only the masked-% KPI
+        and the candidate count touch the host; corrected reads come back
+        once, after the finish pass (pipeline/dcorrect.py)."""
+        import jax
+        import jax.numpy as jnp
+        from proovread_tpu.pipeline.dcorrect import (
+            DeviceCorrector, detect_chimera_device, device_assemble,
+            device_hcr_mask)
+
+        cfg = self.config
+        B0 = len(batch_recs)
+        pad_recs = [SeqRecord(f"_pad{i}", "A" * 8)
+                    for i in range(cfg.batch_reads - B0)]
+        lr = pack_reads(list(batch_recs) + pad_recs, pad_len=Lp)
+        if not hasattr(self, "_dc"):
+            self._dc = DeviceCorrector(chunk=cfg.device_chunk)
+        dc = self._dc
+        codes = jnp.asarray(lr.codes)
+        qual = jnp.asarray(lr.qual)
+        lengths = jnp.asarray(lr.lengths)
+        mask_cols = None
+        masked_frac = -cfg.mask_min_gain_frac
+        max_cov = max(int(min(coverage, cfg.sr_coverage)
+                          * cfg.coverage_scale + 0.5), 1)
+
+        it = 1
+        while it <= cfg.n_iterations:
+            task = f"bwa-{cfg.mode[:2]}-{it}"
+            ap = _align_params(cfg.mode, it)
+            cns = ConsensusParams(
+                qual_weighted=False, use_ref_qual=True,
+                indel_taboo_length=cfg.indel_taboo_length,
+                max_coverage=max_cov,
+            )
+            sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
+                if cfg.sampling else np.arange(n_short)
+            qc, rcq, qq, qlen = sr_dev.take(sel)
+            call, stats = dc.correct_pass(
+                codes, qual, lengths, mask_cols, qc, rcq, qq, qlen, ap, cns,
+                seed_stride=cfg.seed_stride)
+            codes, qual, lengths = device_assemble(call, qual, lengths, Lp)
+
+            mp = (cfg.hcr_mask if it < 4
+                  else cfg.hcr_mask_late).scaled(min_sr_len)
+            mask_cols, frac = device_hcr_mask(qual, lengths, mp)
+            # one RPC for the iteration KPI + admission stat
+            new_frac, n_adm = jax.device_get((frac, stats.n_admitted))
+            new_frac = float(new_frac)
+            gain = new_frac - masked_frac
+            masked_frac = new_frac
+            reports.append(TaskReport(task, masked_frac, stats.n_candidates,
+                                      int(n_adm)))
+            log.info("%s: masked %.1f%%", task, masked_frac * 100)
+
+            it += 1
+            if it <= cfg.n_iterations and (
+                    masked_frac > cfg.mask_shortcut_frac
+                    or gain < cfg.mask_min_gain_frac):
+                log.info("mask shortcut: skipping to finish "
+                         "(masked %.3f, gain %.3f)", masked_frac, gain)
+                break
+
+        # finish: strict params, UNMASKED ref, no ref-qual recycling,
+        # chimera detection (bin/proovread:1573-1579)
+        ap = _align_params(cfg.mode, None)
+        cns = ConsensusParams(
+            qual_weighted=False, use_ref_qual=False,
+            indel_taboo_length=cfg.indel_taboo_length,
+            max_coverage=max(int(min(coverage, cfg.finish_coverage)
+                                 * cfg.coverage_scale + 0.5), 1),
+        )
+        sel = sampler.select(n_short, coverage, cfg.finish_coverage) \
+            if cfg.sampling else np.arange(n_short)
+        qc, rcq, qq, qlen = sr_dev.take(sel)
+        import time as _time
+        _t0 = _time.time()
+        call, stats, aln = dc.correct_pass(
+            codes, qual, lengths, None, qc, rcq, qq, qlen, ap, cns,
+            seed_stride=cfg.seed_stride, collect_aln=True)
+        log.debug("finish correct_pass: %.0f ms", (_time.time() - _t0) * 1e3)
+
+        # the single corrected-read fetch + host assembly (trim needs the
+        # consensus cigar and per-base freqs)
+        _t0 = _time.time()
+        em, base, ins_len, ins_bases, freq, phred, cov, lens_h = \
+            jax.device_get((call.emitted, call.base, call.ins_len,
+                            call.ins_bases, call.freq, call.phred,
+                            call.coverage, lengths))
+        log.debug("finish fetch: %.0f ms", (_time.time() - _t0) * 1e3)
+        _t0 = _time.time()
+        out = []
+        for i in range(B0):
+            nn = int(lens_h[i])
+            out.append(assemble_consensus(
+                lr.ids[i], em[i, :nn], base[i, :nn], ins_len[i, :nn],
+                ins_bases[i, :nn], freq[i, :nn], phred[i, :nn], cov[i, :nn]))
+        log.debug("finish assemble: %.0f ms", (_time.time() - _t0) * 1e3)
+        _t0 = _time.time()
+        detect_chimera_device(out, lens_h, aln)
+        log.debug("finish chimera: %.0f ms", (_time.time() - _t0) * 1e3)
+        frac_phred0 = float(np.mean([o.masked_frac for o in out])) if out \
+            else 0.0
+        reports.append(TaskReport(f"bwa-{cfg.mode[:2]}-finish",
+                                  1.0 - frac_phred0,
+                                  stats.n_candidates,
+                                  int(np.asarray(stats.n_admitted))))
+        log.info("finish: supported %.1f%%", (1.0 - frac_phred0) * 100)
+        chim = [(o.record.id, f, t, s) for o in out for (f, t, s) in o.chimera]
+        return out, chim
 
     def _run_batch(self, batch_recs, sr_all, short_records, sampler,
                    coverage, min_sr_len, reports):

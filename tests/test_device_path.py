@@ -170,6 +170,40 @@ class TestVoteParity:
             else:
                 np.testing.assert_array_equal(a, b, err_msg=name)
 
+    def test_packed_votes_vs_fused(self):
+        """encode_votes + pileup_accumulate_packed must be bit-identical to
+        fused_accumulate for uniform weights."""
+        from proovread_tpu.ops.pileup_kernel import pileup_accumulate_packed
+        from proovread_tpu.ops.votes import encode_votes
+
+        lr, q, win, qual, qlen, read_idx, w0 = _make_candidates(seed=13)
+        B, L = lr.shape
+        R, n = win.shape
+        rb, rs = _bsw_both(q, win, qlen)
+        admitted = np.ones(R, bool)
+        admitted[1::5] = False
+
+        pile_f = pileup_ops.init_pileup(B, L, 6)
+        pile_f = fused_accumulate(
+            pile_f, rs.ops_rev, rs.step_i, rs.step_j,
+            jnp.asarray(q), jnp.asarray(qual), rs.q_start, rs.q_end,
+            jnp.asarray(read_idx), jnp.asarray(w0), jnp.asarray(admitted))
+
+        words = encode_votes(rb.state, rb.qrow, rb.ins_len, jnp.asarray(q),
+                             rb.q_start, rb.q_end)
+        words = jnp.where(jnp.asarray(admitted)[:, None], words, 0)
+        pad = n
+        packed = jnp.zeros((B, L + 2 * n, PACK_LANES), jnp.float32)
+        w0p = jnp.clip(jnp.asarray(w0) + pad, 0, L + 2 * n - n)
+        packed = pileup_accumulate_packed(packed, words, jnp.asarray(read_idx),
+                                          w0p, interpret=True)
+        pile_v = unpack_pileup(packed, pad, L)
+        for name in ("counts", "ins_mbase", "ins_len_votes",
+                     "ins_base_votes"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(pile_f, name)),
+                np.asarray(getattr(pile_v, name)), err_msg=name)
+
     def test_pileup_accumulate_cross_call(self):
         """Accumulation must compose across calls (input_output_aliases)."""
         rng = np.random.default_rng(4)

@@ -179,7 +179,7 @@ class TestPipelineEndToEnd:
         genome, longs, srs = _make_dataset(rng)
 
         pipe = Pipeline(PipelineConfig(
-            mode="sr", n_iterations=2, sampling=False,
+            mode="sr", n_iterations=2, sampling=False, engine="scan",
             trim=TrimParams(min_length=300)))
         res = pipe.run(longs, srs)
 
@@ -213,7 +213,7 @@ class TestPipelineEndToEnd:
         genome, longs, srs = _make_dataset(rng, n_long=2)
         longs.append(SeqRecord("stub", "ACGT" * 10))
         pipe = Pipeline(PipelineConfig(mode="sr", n_iterations=1,
-                                       sampling=False))
+                                       sampling=False, engine="scan"))
         res = pipe.run(longs, srs)
         assert ("stub", "too short") in res.ignored
         assert len(res.untrimmed) == 2
@@ -223,3 +223,38 @@ class TestPipelineEndToEnd:
         recs = [SeqRecord("a", "ACGT" * 100), SeqRecord("a", "ACGT" * 100)]
         with pytest.raises(ValueError, match="duplicate"):
             pipe.read_long(recs, 100)
+
+    def test_device_engine_small(self):
+        """Full device-resident pipeline (Pallas interpret) on a small set:
+        output count, identity improvement, and report structure."""
+        from proovread_tpu.align.params import AlignParams
+        from proovread_tpu.align.sw import sw_batch
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(11)
+        genome, longs, srs = _make_dataset(rng, G=2500, n_long=2,
+                                           lr_err=0.08, n_sr=350)
+        pipe = Pipeline(PipelineConfig(
+            mode="sr", n_iterations=1, sampling=False, engine="device",
+            device_chunk=256, batch_reads=4,
+            trim=TrimParams(min_length=300)))
+        res = pipe.run(longs, srs)
+        assert len(res.untrimmed) == len(longs)
+        assert [r.task for r in res.reports] == ["bwa-sr-1", "bwa-sr-finish"]
+        assert res.reports[0].n_admitted > 0
+
+        loose = AlignParams(clip=0, score_per_base=False, min_out_score=0)
+
+        def ident(codes, ref):
+            pad = ((max(len(codes), len(ref)) + 127) // 128) * 128 + 128
+            qp = np.full(pad, 4, np.int8); qp[:len(codes)] = codes
+            rp = np.full(pad, 4, np.int8); rp[:len(ref)] = ref
+            r = sw_batch(jnp.asarray(qp[None]), jnp.asarray(rp[None]),
+                         jnp.asarray([len(codes)], np.int32), loose)
+            return float(r.score[0]) / (5 * len(codes))
+
+        before = np.mean([ident(encode_ascii(r.seq), genome) for r in longs])
+        after = np.mean([ident(encode_ascii(r.seq), genome)
+                         for r in res.untrimmed])
+        assert after > before + 0.1, (before, after)
+        assert after > 0.9, after
