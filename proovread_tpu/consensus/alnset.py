@@ -113,6 +113,16 @@ def admit_mask(
     return out
 
 
+def _is_in_range(c: Sequence[int], ranges: Sequence[Sequence[int]]) -> bool:
+    """True iff [offset, length) range ``c`` lies fully inside any of
+    ``ranges`` (Sam/Seq.pm:2063-2086)."""
+    c1, c2 = c[0], c[0] + c[1] - 1
+    for r in ranges:
+        if r[0] <= c1 < r[0] + r[1] and r[0] <= c2 < r[0] + r[1]:
+            return True
+    return False
+
+
 @dataclass
 class AlnSet:
     """Alignments of one long read, plus admission bookkeeping."""
@@ -161,14 +171,134 @@ class AlnSet:
 
         self.alns = [a for a in self.alns if keep(a)]
 
+    # -- coverage + utg filters (Sam/Seq.pm:746-764,949-1084) ------------
+    def coverage(self) -> np.ndarray:
+        """Per-position alignment coverage from untrimmed reference spans
+        (the reference sums taboo-trimmed state-matrix columns,
+        ``Sam/Seq.pm:746-764``; span counting differs only at the few
+        trimmed edge bases and needs no matrix build)."""
+        cov = np.zeros(self.ref_len, np.int32)
+        for a in self.alns:
+            lo = max(0, a.pos0)
+            hi = min(self.ref_len, a.pos0 + a.span)
+            cov[lo:hi] += 1
+        return cov
+
+    def high_coverage_windows(self, cmax: float) -> List[Tuple[int, int]]:
+        """[offset, length] runs where coverage >= cmax (the rep-region /
+        utg overlap-window scan, Sam/Seq.pm:957-974, bam2cns:402-422)."""
+        cov = self.coverage()
+        out: List[Tuple[int, int]] = []
+        high = np.flatnonzero(cov >= cmax)
+        if high.size == 0:
+            return out
+        breaks = np.flatnonzero(np.diff(high) > 1)
+        starts = np.concatenate([[high[0]], high[breaks + 1]])
+        ends = np.concatenate([high[breaks], [high[-1]]]) + 1
+        return [(int(s), int(e - s)) for s, e in zip(starts, ends)]
+
+    def filter_rep_region_alns(self, rep_coverage: Optional[float] = None
+                               ) -> None:
+        """Drop alignments fully contained in repeat windows: coverage >=
+        RepCoverage runs, extended by 150bp each side and clipped to the
+        read (Sam/Seq.pm:949-999)."""
+        cmax = (rep_coverage if rep_coverage is not None
+                else self.params.rep_coverage)
+        if not cmax:
+            return
+        wins = self.high_coverage_windows(cmax)
+        rwin = []
+        for s, ln in wins:
+            lo = max(0, s - 150)
+            rwin.append([lo, min(s + ln + 150, self.ref_len) - lo])
+        if not rwin:
+            return
+        keep = np.array([not _is_in_range((a.pos0, a.span), rwin)
+                         for a in self.alns], bool)
+        self.alns = [a for a, k in zip(self.alns, keep) if k]
+        if self.aln_bins is not None:       # keep admission bookkeeping sync
+            self.aln_bins = self.aln_bins[keep]
+            spans = np.array([a.span for a in self.alns], np.float64)
+            self.bin_bases = np.bincount(
+                self.aln_bins, weights=spans, minlength=self.n_bins)
+
+    def filter_contained_alns(self) -> None:
+        """Drop alignments contained (after edge shrink: hits <21bp collapse
+        to their center, longer hits lose 10% per side) within a longer
+        alignment's span; near-identical-length pairs keep the higher score
+        (Sam/Seq.pm:1001-1047)."""
+        inv = self.params.invert_scores
+        alns = list(self.alns)
+        # queue sorted by span descending; pop shortest from the tail
+        order = sorted(range(len(alns)), key=lambda i: -alns[i].span)
+        iids = [i for i in order]
+        coords = [[alns[i].pos0, alns[i].span] for i in order]
+        scores = [alns[i].effective_score(inv) or 0.0 for i in order]
+        removed = set()
+        while len(iids) > 1:
+            iid = iids.pop()
+            coo = coords.pop()
+            if coo[1] < 21:
+                coo = [coo[0] + coo[1] // 2, 1]
+            else:
+                ad = int(coo[1] * 0.1)
+                coo = [coo[0] + ad, coo[1] - 2 * ad]
+            if _is_in_range(coo, coords):
+                if coo[1] > coords[-1][1] - 40:
+                    # near-identical length: keep the better-scoring one
+                    i = len(coords)
+                    if scores[i] > scores[i - 1]:
+                        iid_restore = iid
+                        iid = iids.pop()
+                        coords.pop()
+                        iids.append(iid_restore)
+                        coords.append(coo)
+                removed.add(iid)
+        self.alns = [a for j, a in enumerate(alns) if j not in removed]
+
+    def filter_by_coverage(self, cov: float) -> None:
+        """Tighten the per-bin base budget to ``cov`` x bin_size and evict
+        the lowest-ranked admitted alignments of each over-full bin
+        (Sam/Seq.pm:1059-1084). Requires a prior :meth:`admit`."""
+        if cov >= self.params.max_coverage or self.aln_bins is None:
+            return
+        budget = cov * self.params.bin_size
+        inv = self.params.invert_scores
+        keep = np.ones(len(self.alns), bool)
+        for b in np.unique(self.aln_bins):
+            mine = np.flatnonzero(self.aln_bins == b)
+            if mine.size < 2:
+                continue
+            spans = np.array([self.alns[i].span for i in mine], np.float64)
+            scores = np.array(
+                [s if (s := self.alns[i].ncscore(inv)) is not None
+                 else -np.inf for i in mine])
+            order = mine[np.lexsort((mine, -scores))]
+            ospans = np.array([self.alns[i].span for i in order], np.float64)
+            total = spans.sum()
+            drop = 0
+            while total > budget and mine.size - drop >= 2:
+                drop += 1
+                total -= ospans[-drop]
+            if drop:
+                keep[order[len(order) - drop:]] = False
+        idx = np.flatnonzero(keep)
+        self.alns = [self.alns[i] for i in idx]
+        self.aln_bins = self.aln_bins[idx]
+        spans = np.array([a.span for a in self.alns], np.float64)
+        self.bin_bases = np.bincount(
+            self.aln_bins, weights=spans, minlength=self.n_bins)
+
     def admit(self, cap_coverage: bool = True) -> None:
         """Score-binned admission: per bin, rank by ncscore (desc) and admit
         while the cumulative admitted bases *before* an alignment stay within
         bin_max_bases (the reference admits the crossing alignment too:
         Sam/Seq.pm:591). With ``cap_coverage`` False (utg mode's plain
-        add_aln), all alignments with a defined ncscore are kept."""
+        add_aln, which needs no score) all alignments are kept."""
         p = self.params
-        alns = [a for a in self.alns if a.ncscore(p.invert_scores) is not None]
+        alns = (list(self.alns) if not cap_coverage else
+                [a for a in self.alns
+                 if a.ncscore(p.invert_scores) is not None])
         if not alns:
             self.alns = []
             self.aln_bins = np.zeros(0, np.int32)

@@ -4,6 +4,8 @@ from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.io.fasta import FastaReader, FastaWriter
 from proovread_tpu.io.fastq import FastqReader, FastqWriter
 from proovread_tpu.io.batch import ReadBatch, pack_reads
+from proovread_tpu.io.sam import (SamAlignment, SamHeader, SamReader,
+                                  SamWriter, BamWriter, restore_secondary)
 
 __all__ = [
     "SeqRecord",
@@ -13,4 +15,10 @@ __all__ = [
     "FastqWriter",
     "ReadBatch",
     "pack_reads",
+    "SamAlignment",
+    "SamHeader",
+    "SamReader",
+    "SamWriter",
+    "BamWriter",
+    "restore_secondary",
 ]

@@ -7,6 +7,8 @@ from proovread_tpu.pipeline.driver import (
 )
 from proovread_tpu.pipeline.masking import MaskParams, hcr_intervals, mask_batch
 from proovread_tpu.pipeline.sampling import CoverageSampler
+from proovread_tpu.pipeline.sam2cns import (Sam2CnsConfig, sam2cns,
+                                            sam2cns_records)
 from proovread_tpu.pipeline.trim import TrimParams, trim_records
 
 __all__ = [
@@ -14,4 +16,5 @@ __all__ = [
     "Pipeline", "PipelineConfig", "PipelineResult", "TaskReport",
     "MaskParams", "hcr_intervals", "mask_batch",
     "CoverageSampler", "TrimParams", "trim_records",
+    "Sam2CnsConfig", "sam2cns", "sam2cns_records",
 ]

@@ -238,23 +238,33 @@ class AlnData:
     r_start: np.ndarray     # i32 [R]
     r_end: np.ndarray       # i32 [R]
     cns: ConsensusParams
-    state: object           # device i8 [R, n] window-col states (-1 = none)
-    qrow: object            # device i16 [R, n]
-    ins_len: object         # device i16 [R, n]
+    chunks: list            # per-chunk device (state i8, qrow i16, ins_len
+                            # i16) [CH, n] slabs, kept unconcatenated so the
+                            # chimera path adds no extra device allocation
+    chunk_size: int
     _rows: dict = field(default_factory=dict)
 
     def prefetch(self, cis) -> None:
-        """Fetch the expanded slabs of the given candidates in ONE gather +
-        transfer (the tunneled fetch path is bandwidth-bound; per-row pulls
-        would pay the RPC latency per candidate)."""
+        """Fetch the expanded slabs of the given candidates in ONE transfer
+        (one gather per touched chunk, a single device_get for all — the
+        tunneled fetch path is bandwidth-bound; per-row pulls would pay the
+        RPC latency per candidate)."""
         cis = [int(c) for c in cis if int(c) not in self._rows]
         if not cis:
             return
-        idx = jnp.asarray(np.asarray(cis, np.int32))
-        st, qr, il = jax.device_get(
-            (self.state[idx], self.qrow[idx], self.ins_len[idx]))
-        for j, ci in enumerate(cis):
-            self._rows[ci] = (st[j], qr[j], il[j])
+        by_chunk: dict = {}
+        for ci in cis:
+            by_chunk.setdefault(ci // self.chunk_size, []).append(ci)
+        groups, gathered = [], []
+        for ch, group in sorted(by_chunk.items()):
+            st_d, qr_d, il_d = self.chunks[ch]
+            idx = jnp.asarray(
+                np.asarray(group, np.int32) - ch * self.chunk_size)
+            groups.append(group)
+            gathered.append((st_d[idx], qr_d[idx], il_d[idx]))
+        for group, (st, qr, il) in zip(groups, jax.device_get(gathered)):
+            for j, ci in enumerate(group):
+                self._rows[ci] = (st[j], qr[j], il[j])
 
     def column_states(self, ci: int):
         """Expanded :class:`ColumnStates` of candidate ``ci`` (or None),

@@ -1,0 +1,155 @@
+"""External-mapping consensus entry — the role of ``bin/bam2cns`` /
+``bin/sam2cns``: correct long reads from an externally produced SAM/BAM
+mapping instead of the built-in JAX mapper. This is the reference's designed
+resume boundary (``proovread.cfg:130-132`` sam/bam modes,
+``bin/proovread:718-736``) and the interop point with the Perl pipeline.
+
+Flow (``bin/bam2cns:332-455``, ``bin/sam2cns:554-632``): group alignments by
+reference long read, restore secondary-alignment seq/qual from the primary,
+apply score filters + binned admission (or plain add in utg mode), parse MCR
+masks from the reference read description, call consensus (emitting refs
+without alignments too), optionally detect chimera.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from proovread_tpu.consensus.alnset import AlnSet
+from proovread_tpu.consensus.engine import ConsensusEngine, ConsensusResult
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.batch import pack_reads
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.io.sam import SamAlignment, SamReader, restore_secondary
+
+log = logging.getLogger("proovread_tpu")
+
+_MCR_RE = re.compile(r"MCR\d+:(\d+),(\d+)")
+# NB: the reference also scans HPL:\d+ annotations (bin/bam2cns:388) but —
+# like bam2cns itself — never consumes them; not parsed here.
+
+
+@dataclass
+class Sam2CnsConfig:
+    params: ConsensusParams = field(default_factory=ConsensusParams)
+    utg_mode: bool = False            # plain add + contained filter + owin
+    detect_chimera: bool = False
+    ignore_mcr: bool = False          # --ignore-mcr / --ignore-hcr
+    max_ref_seqs: int = 100           # refs per consensus batch
+    haplo_coverage: Optional[float] = None   # filter_by_coverage cutoff
+
+
+def parse_mcrs(desc: str) -> List[Tuple[int, int]]:
+    """MCR annotations from a reference-read description
+    (``bin/bam2cns:382-391``)."""
+    return [(int(a), int(b)) for a, b in _MCR_RE.findall(desc or "")]
+
+
+def _collect_blocks(alns_in: Iterable[SamAlignment], wanted: Dict[str, int],
+                    invert_scores: bool) -> Dict[int, list]:
+    """Group the stream into per-reference engine :class:`Alignment` lists.
+    Records convert to compact numpy form (int8 codes + cigar-op arrays) as
+    they stream, so peak memory is O(total aligned bases), not O(SAM text)
+    (the reference streams one rname-block of a sorted SAM at a time,
+    ``bin/sam2cns:554-632``). Secondary records whose primary has not
+    streamed yet ('*' seq, legal in coordinate-sorted input) are dropped
+    with a warning — the reference aborts on them (``bin/bam2cns:348``)."""
+    out: Dict[int, list] = {}
+    n_unresolved = 0
+    for rec in restore_secondary(alns_in):
+        if rec.is_supplementary or rec.cigar in ("*", ""):
+            continue
+        if rec.seq == "*":
+            n_unresolved += 1
+            continue
+        ri = wanted.get(rec.rname)
+        if ri is not None:
+            out.setdefault(ri, []).append(rec.to_alignment(invert_scores))
+    if n_unresolved:
+        log.warning(
+            "%d secondary alignments dropped (primary seq not yet seen; "
+            "sort or samfilter the input to keep them)", n_unresolved)
+    return out
+
+
+def sam2cns(
+    source: Union[str, Iterable[SamAlignment]],
+    refs: Sequence[SeqRecord],
+    config: Optional[Sam2CnsConfig] = None,
+) -> Iterator[ConsensusResult]:
+    """Consensus-correct ``refs`` using the alignments in ``source`` (path to
+    SAM/BAM, or an iterable of records). Yields one :class:`ConsensusResult`
+    per reference read, in input order — including refs no alignment maps to
+    (``bin/sam2cns:567-577``). All alignments are held simultaneously, but
+    in compact engine form (int8 codes + cigar arrays): peak memory is
+    O(total aligned bases) plus one ``max_ref_seqs`` batch of expanded
+    pileup columns; chunk ``refs`` externally (the reference's byte-offset
+    chunking, ``bin/proovread:1547-1606``) to bound the former."""
+    cfg = config or Sam2CnsConfig()
+    if isinstance(source, str):
+        reader = SamReader(source)
+        alns_in: Iterable[SamAlignment] = iter(reader)
+    else:
+        alns_in = source
+
+    wanted = {r.id: i for i, r in enumerate(refs)}
+    by_ref = _collect_blocks(alns_in, wanted, cfg.params.invert_scores)
+
+    engine = ConsensusEngine(params=cfg.params)
+    for start in range(0, len(refs), cfg.max_ref_seqs):
+        group = refs[start:start + cfg.max_ref_seqs]
+        batch = pack_reads(group)
+        alnsets: List[AlnSet] = []
+        ignore: List[List[Tuple[int, int]]] = []
+        for j, ref in enumerate(group):
+            aset = AlnSet(ref_id=ref.id, ref_len=len(ref), params=cfg.params)
+            aset.alns.extend(by_ref.pop(start + j, ()))
+            coords = ([] if cfg.ignore_mcr else parse_mcrs(ref.desc))
+
+            aset.filter_by_scores()
+            if cfg.utg_mode:
+                # rep-region filter sees uncapped coverage in utg mode
+                # (reference utg path adds alignments without binning
+                # before bam2cns:395 runs)
+                if cfg.params.rep_coverage:
+                    aset.filter_rep_region_alns()
+                aset.filter_contained_alns()
+                # high-coverage overlap windows vote nothing
+                # (bin/bam2cns:398-422)
+                if cfg.params.rep_coverage:
+                    coords = coords + aset.high_coverage_windows(
+                        cfg.params.rep_coverage)
+                aset.admit(cap_coverage=False)
+            else:
+                # admission first: the reference's filter runs after the
+                # add_aln_by_score stream loop, so it sees coverage-capped
+                # alignments (bin/bam2cns:345-354 then :395)
+                aset.admit()
+                if cfg.params.rep_coverage:
+                    aset.filter_rep_region_alns()
+                if cfg.haplo_coverage is not None:
+                    aset.filter_by_coverage(cfg.haplo_coverage)
+            alnsets.append(aset)
+            ignore.append(coords)
+
+        results = engine.consensus_batch(
+            batch, alnsets, ignore_coords=ignore,
+            detect_chimera=cfg.detect_chimera)
+        yield from results
+
+
+def sam2cns_records(
+    source, refs: Sequence[SeqRecord],
+    config: Optional[Sam2CnsConfig] = None,
+) -> Tuple[List[SeqRecord], List[Tuple[str, int, int, float]]]:
+    """Convenience wrapper: corrected records + flat chimera list."""
+    out, chim = [], []
+    for res in sam2cns(source, refs, config):
+        out.append(res.record)
+        chim.extend((res.record.id, f, t, s) for f, t, s in res.chimera)
+    return out, chim

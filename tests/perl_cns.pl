@@ -1,0 +1,68 @@
+#!/usr/bin/env perl
+# Golden-parity driver: runs the REFERENCE consensus engine (Sam::Seq from
+# /root/reference/lib, pure Perl) over a headerless SAM + ref FASTQ and
+# prints the corrected FASTQ to stdout. Mirrors bin/bam2cns's class
+# push-down (bam2cns:227-237) and consensus call (bam2cns:434-438).
+use strict;
+use warnings;
+use lib "/root/reference/lib";
+use Sam::Alignment;
+use Sam::Seq;
+use Fastq::Parser;
+use Getopt::Long;
+
+my %o = (
+    'trim' => 1, 'indel-taboo' => 0.1, 'indel-taboo-length' => 0,
+    'max-coverage' => 50, 'bin-size' => 20, 'use-ref-qual' => 0,
+    'qual-weighted' => 0, 'max-ins-length' => 0, 'fallback-phred' => 1,
+    'utg-mode' => 0,
+);
+GetOptions(\%o, 'sam=s', 'ref=s', 'trim=i', 'indel-taboo=f',
+           'indel-taboo-length=i', 'max-coverage=i', 'bin-size=i',
+           'use-ref-qual=i', 'qual-weighted=i', 'max-ins-length=i',
+           'fallback-phred=i', 'utg-mode=i') or die "bad options";
+
+Sam::Seq->Trim($o{'trim'});
+Sam::Seq->InDelTaboo($o{'indel-taboo'});
+Sam::Seq->InDelTabooLength($o{'indel-taboo-length'});
+Sam::Seq->MaxCoverage($o{'max-coverage'});
+Sam::Seq->BinSize($o{'bin-size'});
+Sam::Seq->MaxInsLength($o{'max-ins-length'});
+Sam::Seq->FallbackPhred($o{'fallback-phred'});
+
+my (%refs, @ids);
+# bam2cns:247-254: guess + pin the phred offset on the ref parser so
+# Fastq::Seq->phreds subtracts it (undef offset would yield raw ASCII)
+my $fp = Fastq::Parser->new(file => $o{ref});
+my $po = $fp->guess_phred_offset() // 33;
+$fp->phred_offset($po);
+while (my $r = $fp->next_seq()) {
+    $refs{$r->id} = $r;
+    push @ids, $r->id;
+}
+
+my %alns;
+open(my $sfh, '<', $o{sam}) or die $!;
+while (my $line = <$sfh>) {
+    next if $line =~ /^@/ or $line !~ /\S/;
+    my $aln = Sam::Alignment->new($line);
+    push @{$alns{$aln->rname}}, $aln;
+}
+close $sfh;
+
+for my $id (@ids) {
+    my $ref = $refs{$id};
+    my $sso = Sam::Seq->new(
+        id  => $id,
+        len => length($ref->seq),
+        ref => $ref,
+    );
+    for my $aln (@{$alns{$id} // []}) {
+        $o{'utg-mode'} ? $sso->add_aln($aln) : $sso->add_aln_by_score($aln);
+    }
+    my $con = $sso->consensus(
+        use_ref_qual  => $o{'use-ref-qual'},
+        qual_weighted => $o{'qual-weighted'},
+    );
+    print "$con";
+}

@@ -1,0 +1,187 @@
+"""Golden parity vs the REFERENCE consensus engine (pure-Perl Sam::Seq).
+
+The acceptance metric from BASELINE.json: <= 0.1% consensus-base
+disagreement. Synthetic long reads with a known edit script vs the truth are
+corrected from identical SAM input by (a) ``tests/perl_cns.pl`` driving
+``/root/reference/lib/Sam/Seq.pm`` and (b) our ``pipeline/sam2cns.py``; the
+corrected sequences are compared base-by-base through a difflib alignment.
+
+CIGARs are derived exactly from the edit script (no aligner involved), so
+both engines see the same alignments, scores and coordinates.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.pipeline.sam2cns import Sam2CnsConfig, sam2cns_records
+
+PERL = shutil.which("perl")
+DRIVER = Path(__file__).parent / "perl_cns.pl"
+
+pytestmark = pytest.mark.skipif(
+    PERL is None, reason="perl not available")
+
+BASES = "ACGT"
+
+
+def _simulate(rng, glen=1200, err=0.06, n_sr=260, sr_len=100):
+    """Truth genome; long read = truth + edit script; short reads = exact
+    truth substrings with CIGARs projected through the edit script."""
+    truth = "".join(BASES[i] for i in rng.integers(0, 4, glen))
+
+    # edit script over truth positions: per truth base, (kept_base|None,
+    # inserted_bases_before). Build long read + truth->long coordinate map.
+    lr_chars = []
+    lr_of_truth = np.full(glen, -1, np.int64)   # truth pos -> long pos (kept)
+    deleted = np.zeros(glen, bool)
+    for t in range(glen):
+        u = rng.random()
+        if u < err * 0.4:                        # deletion in long read
+            deleted[t] = True
+            continue
+        if u < err * 0.7:                        # insertion before this base
+            lr_chars.append(BASES[rng.integers(0, 4)])
+        if u < err * 0.9 and u >= err * 0.7:     # substitution
+            lr_of_truth[t] = len(lr_chars)
+            lr_chars.append(BASES[(BASES.index(truth[t]) +
+                                   1 + rng.integers(0, 3)) % 4])
+            continue
+        lr_of_truth[t] = len(lr_chars)
+        lr_chars.append(truth[t])
+    long_read = "".join(lr_chars)
+
+    # short reads: exact truth substrings; cigar vs the long read
+    sam_lines = []
+    for i in range(n_sr):
+        st = int(rng.integers(0, glen - sr_len))
+        seq = truth[st:st + sr_len]
+        # walk truth positions st..st+sr_len-1
+        ops = []                                  # (op, n)
+
+        def put(op, n=1):
+            if ops and ops[-1][0] == op:
+                ops[-1][1] += n
+            else:
+                ops.append([op, n])
+
+        pos0 = None
+        matches = 0
+        for t in range(st, st + sr_len):
+            if deleted[t]:
+                put("I")                          # query base absent in ref
+                continue
+            lp = lr_of_truth[t]
+            if pos0 is None:
+                pos0 = lp
+            else:
+                gap = lp - last_lp - 1
+                if gap > 0:
+                    put("D", gap)                 # ref has inserted bases
+            put("M")
+            if long_read[lp] == truth[t]:
+                matches += 1
+            last_lp = lp
+        if pos0 is None:
+            continue
+        # leading I before the first M has no anchor: trim to first M
+        while ops and ops[0][0] == "I":
+            n = ops.pop(0)[1]
+            seq = seq[n:]
+        while ops and ops[-1][0] in "ID":
+            n, op = ops[-1][1], ops.pop(-1)[0]
+            if op == "I":
+                seq = seq[:-n]
+        if not ops:
+            continue
+        cigar = "".join(f"{n}{op}" for op, n in ops)
+        score = 5 * matches
+        sam_lines.append("\t".join([
+            f"s{i}", "0", "lr0", str(int(pos0) + 1), "60", cigar, "*", "0",
+            "0", seq, "I" * len(seq), f"AS:i:{score}"]))
+    return truth, long_read, sam_lines
+
+
+def _identity(a: str, b: str) -> float:
+    import difflib
+    sm = difflib.SequenceMatcher(None, a, b, autojunk=False)
+    matches = sum(m.size for m in sm.get_matching_blocks())
+    return matches / max(len(a), len(b), 1)
+
+
+def _run_perl(sam_path, ref_path, **knobs):
+    args = [PERL, str(DRIVER), "--sam", str(sam_path), "--ref",
+            str(ref_path)]
+    for k, v in knobs.items():
+        args += [f"--{k.replace('_', '-')}", str(v)]
+    out = subprocess.run(args, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = out.stdout.strip().split("\n")
+    recs = {}
+    for j in range(0, len(lines), 4):
+        rid = lines[j][1:].split()[0]
+        recs[rid] = (lines[j + 1], lines[j + 3])
+    return recs
+
+
+@pytest.mark.parametrize("seed,use_ref_qual", [(0, 0), (1, 1)])
+def test_consensus_parity_vs_perl(tmp_path, seed, use_ref_qual):
+    rng = np.random.default_rng(seed)
+    truth, long_read, sam_lines = _simulate(rng)
+    sam_path = tmp_path / "in.sam"
+    sam_path.write_text("".join(ln + "\n" for ln in sam_lines))
+    ref_path = tmp_path / "ref.fq"
+    ref_qual = "&" * len(long_read)              # phred 5
+    ref_path.write_text(f"@lr0\n{long_read}\n+\n{ref_qual}\n")
+
+    knobs = dict(indel_taboo_length=7, max_coverage=50, bin_size=20,
+                 use_ref_qual=use_ref_qual, trim=1)
+    perl = _run_perl(sam_path, ref_path, **knobs)
+    assert "lr0" in perl
+    perl_seq = perl["lr0"][0].upper()
+
+    params = ConsensusParams(indel_taboo_length=7, max_coverage=50,
+                             bin_size=20, use_ref_qual=bool(use_ref_qual))
+    refs = [SeqRecord("lr0", long_read,
+                      qual=np.full(len(long_read), 5, np.uint8))]
+    ours, _ = sam2cns_records(str(sam_path), refs, Sam2CnsConfig(params=params))
+    our_seq = ours[0].seq.upper()
+
+    # both engines should land essentially on the truth
+    assert _identity(perl_seq, truth) > 0.99
+    assert _identity(our_seq, truth) > 0.99
+
+    # BASELINE.json acceptance: <= 0.1% disagreement between the engines
+    dis = 1.0 - _identity(our_seq, perl_seq)
+    assert dis <= 0.001, (
+        f"consensus disagreement {dis:.4%} vs Perl engine "
+        f"(ours {len(our_seq)}bp, perl {len(perl_seq)}bp)")
+
+
+def test_parity_sparse_coverage(tmp_path):
+    """Low coverage leaves uncorrected stretches — both engines must agree
+    on where correction happens, not just on the corrected value."""
+    rng = np.random.default_rng(7)
+    truth, long_read, sam_lines = _simulate(rng, glen=900, n_sr=40)
+    sam_path = tmp_path / "in.sam"
+    sam_path.write_text("".join(ln + "\n" for ln in sam_lines))
+    ref_path = tmp_path / "ref.fq"
+    ref_path.write_text(
+        f"@lr0\n{long_read}\n+\n{'&' * len(long_read)}\n")
+
+    perl = _run_perl(sam_path, ref_path, indel_taboo_length=7,
+                     use_ref_qual=1)
+    perl_seq = perl["lr0"][0].upper()
+
+    params = ConsensusParams(indel_taboo_length=7, use_ref_qual=True)
+    refs = [SeqRecord("lr0", long_read,
+                      qual=np.full(len(long_read), 5, np.uint8))]
+    ours, _ = sam2cns_records(str(sam_path), refs,
+                              Sam2CnsConfig(params=params))
+    dis = 1.0 - _identity(ours[0].seq.upper(), perl_seq)
+    assert dis <= 0.001, f"sparse-coverage disagreement {dis:.4%}"

@@ -1,0 +1,277 @@
+"""SAM/BAM interop: record model, SAM/BAM round-trips, secondary restore,
+sam2cns external-mapping consensus, and the utg filters.
+
+Reference parity targets: ``lib/Sam/Alignment.pm`` (record/flag/tag/cigar
+accessors), ``lib/Sam/Parser.pm`` (reader-writer), ``bin/samfilter``
+(secondary restore), ``bin/bam2cns``/``bin/sam2cns`` (consensus worker),
+``lib/Sam/Seq.pm:949-1084`` (rep-region/contained/coverage filters).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from proovread_tpu.consensus.alnset import Alignment, AlnSet, _is_in_range
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.io.sam import (BamWriter, SamAlignment, SamHeader,
+                                  SamReader, SamWriter, restore_secondary)
+from proovread_tpu.pipeline.sam2cns import (Sam2CnsConfig, parse_mcrs,
+                                            sam2cns_records)
+
+SAM_LINE = ("r1\t16\tref1\t5\t60\t3S10M2I4M1D6M\t*\t0\t0\t"
+            "ACGTACGTACGTACGTACGTACGTA\tIIIIIIIIIIIIIIIIIIIIIIIII\t"
+            "AS:i:77\tNM:i:3\tXX:Z:hello")
+
+
+class TestSamRecord:
+    def test_parse_fields(self):
+        a = SamAlignment.from_sam_line(SAM_LINE)
+        assert a.qname == "r1"
+        assert a.flag == 16 and a.is_reverse and not a.is_secondary
+        assert a.rname == "ref1"
+        assert a.pos == 4                      # 0-based
+        assert a.cigar == "3S10M2I4M1D6M"
+        assert a.opt("AS") == 77 and a.score == 77.0
+        assert a.opt("XX") == "hello"
+        assert a.opt("ZZ", "dflt") == "dflt"
+
+    def test_cigar_geometry(self):
+        a = SamAlignment.from_sam_line(SAM_LINE)
+        assert a.ref_span == 10 + 4 + 1 + 6    # M + M + D + M
+        assert a.length == 10 + 2 + 4 + 6      # M + I
+        assert a.full_length == 25             # + soft clip
+
+    def test_round_trip_line(self):
+        a = SamAlignment.from_sam_line(SAM_LINE)
+        b = SamAlignment.from_sam_line(a.to_sam_line())
+        assert a == b
+
+    def test_to_alignment(self):
+        a = SamAlignment.from_sam_line(SAM_LINE)
+        aln = a.to_alignment()
+        assert aln.pos0 == 4
+        assert aln.score == 77.0
+        assert aln.span == a.ref_span
+        assert len(aln.seq_codes) == 25
+        np.testing.assert_array_equal(aln.qual, np.full(25, 40))
+
+    def test_phreds_offset(self):
+        a = SamAlignment.from_sam_line(SAM_LINE)
+        assert a.phreds()[0] == ord("I") - 33
+
+
+class TestSamIO:
+    def _records(self):
+        recs = []
+        for i in range(5):
+            recs.append(SamAlignment(
+                qname=f"q{i}", flag=0 if i % 2 == 0 else 16, rname="lr1",
+                pos=i * 7, mapq=50 + i, cigar="20M", seq="ACGT" * 5,
+                qual="I" * 20,
+                tags={"AS": ("i", 90 - i), "XN": ("Z", f"v{i}")}))
+        return recs
+
+    def test_sam_file_round_trip(self, tmp_path):
+        hdr = SamHeader()
+        hdr.add_ref("lr1", 500)
+        p = str(tmp_path / "x.sam")
+        with SamWriter(p, header=hdr) as w:
+            for r in self._records():
+                w.write(r)
+        rd = SamReader(p)
+        assert rd.header.refs == {"lr1": 500}
+        got = list(rd)
+        assert got == self._records()
+
+    def test_bam_round_trip(self, tmp_path):
+        hdr = SamHeader()
+        hdr.add_ref("lr1", 500)
+        hdr.add_ref("lr2", 300)
+        p = str(tmp_path / "x.bam")
+        recs = self._records()
+        recs[2].rname = "lr2"
+        recs[3].tags["XB"] = ("B", ("i", [1, -2, 3]))
+        recs[4].tags["XF"] = ("f", 1.5)
+        with BamWriter(p, hdr) as w:
+            for r in recs:
+                w.write(r)
+        rd = SamReader(p)
+        assert rd.header.refs == {"lr1": 500, "lr2": 300}
+        got = list(rd)
+        for a, b in zip(recs, got):
+            assert a.qname == b.qname and a.flag == b.flag
+            assert a.rname == b.rname and a.pos == b.pos
+            assert a.cigar == b.cigar and a.seq == b.seq and a.qual == b.qual
+            assert b.opt("AS") == a.opt("AS")
+        assert got[3].opt("XB") == ("i", [1, -2, 3])
+        assert got[4].opt("XF") == pytest.approx(1.5)
+
+    def test_bam_qual_absent(self, tmp_path):
+        hdr = SamHeader()
+        hdr.add_ref("lr1", 100)
+        p = str(tmp_path / "q.bam")
+        with BamWriter(p, hdr) as w:
+            w.write(SamAlignment(qname="q", rname="lr1", pos=0,
+                                 cigar="4M", seq="ACGT", qual="*"))
+        (got,) = list(SamReader(p))
+        assert got.qual == "*" and got.seq == "ACGT"
+
+    def test_gzip_sam(self, tmp_path):
+        import gzip
+        p = str(tmp_path / "x.sam.gz")
+        with gzip.open(p, "wt") as fh:
+            fh.write("@SQ\tSN:lr1\tLN:99\n")
+            fh.write(SAM_LINE + "\n")
+        rd = SamReader(p)
+        assert rd.header.refs == {"lr1": 99}
+        assert list(rd)[0].qname == "r1"
+
+
+class TestRestoreSecondary:
+    def test_restore(self):
+        prim = SamAlignment(qname="q", flag=0, rname="a", pos=0,
+                            cigar="8M", seq="ACGTACGT", qual="IIIIHHHH")
+        sec_fwd = SamAlignment(qname="q", flag=0x100, rname="a", pos=50,
+                               cigar="8M", seq="*", qual="*")
+        sec_rev = SamAlignment(qname="q", flag=0x110, rname="a", pos=70,
+                               cigar="8M", seq="*", qual="*")
+        unmapped = SamAlignment(qname="u", flag=0x4)
+        out = list(restore_secondary([prim, sec_fwd, sec_rev, unmapped]))
+        assert len(out) == 3                       # unmapped dropped
+        assert out[1].seq == "ACGTACGT" and out[1].qual == "IIIIHHHH"
+        assert out[2].seq == "ACGTACGT"[::-1].translate(
+            str.maketrans("ACGT", "TGCA"))
+        assert out[2].qual == "HHHHIIII"
+
+    def test_default_qual(self):
+        prim = SamAlignment(qname="q", flag=0, rname="a", pos=0,
+                            cigar="4M", seq="ACGT", qual="*")
+        (out,) = list(restore_secondary([prim]))
+        assert out.qual == "????"
+
+
+def _mk_aln(pos, span, score=100.0, qname="q"):
+    return Alignment.from_cigar_str(
+        qname=qname, pos0=pos, seq_codes=np.zeros(span, np.int8),
+        cigar=f"{span}M", score=score)
+
+
+class TestUtgFilters:
+    def test_is_in_range(self):
+        assert _is_in_range((5, 10), [(0, 20)])
+        assert not _is_in_range((5, 20), [(0, 20)])
+        assert not _is_in_range((0, 5), [(2, 10)])
+
+    def test_high_coverage_windows(self):
+        aset = AlnSet(ref_id="r", ref_len=100,
+                      params=ConsensusParams(rep_coverage=3))
+        for _ in range(4):
+            aset.alns.append(_mk_aln(20, 30))
+        aset.alns.append(_mk_aln(0, 10))
+        wins = aset.high_coverage_windows(3)
+        assert wins == [(20, 30)]
+
+    def test_filter_rep_region(self):
+        p = ConsensusParams(rep_coverage=3)
+        aset = AlnSet(ref_id="r", ref_len=2000, params=p)
+        for _ in range(5):                      # repeat pileup at 800..1000
+            aset.alns.append(_mk_aln(800, 200))
+        aset.alns.append(_mk_aln(0, 300))       # clean left aln
+        aset.alns.append(_mk_aln(1500, 300))    # clean right aln
+        aset.filter_rep_region_alns()
+        # window extends ±150: [650, 1150); the contained five drop
+        assert len(aset.alns) == 2
+        assert {a.pos0 for a in aset.alns} == {0, 1500}
+
+    def test_filter_contained(self):
+        aset = AlnSet(ref_id="r", ref_len=1000)
+        big = _mk_aln(100, 500, score=200, qname="big")
+        inner = _mk_aln(300, 100, score=50, qname="inner")
+        outside = _mk_aln(700, 200, score=80, qname="out")
+        aset.alns = [big, inner, outside]
+        aset.filter_contained_alns()
+        names = {a.qname for a in aset.alns}
+        assert names == {"big", "out"}
+
+    def test_filter_contained_score_swap(self):
+        # near-identical spans: the higher-scoring one survives
+        aset = AlnSet(ref_id="r", ref_len=1000)
+        a = _mk_aln(100, 200, score=50, qname="lo")
+        b = _mk_aln(100, 210, score=500, qname="hi_short")
+        aset.alns = [a, b]
+        aset.filter_contained_alns()
+        assert len(aset.alns) == 2 or \
+            {x.qname for x in aset.alns} == {"hi_short"}
+
+    def test_filter_by_coverage(self):
+        p = ConsensusParams(bin_size=20, max_coverage=50)
+        aset = AlnSet(ref_id="r", ref_len=100, params=p)
+        for i in range(30):
+            aset.alns.append(_mk_aln(40, 20, score=100 + i))
+        aset.filter_by_scores()
+        aset.admit()
+        n0 = len(aset.alns)
+        aset.filter_by_coverage(5)              # budget 100 bases = 5 alns
+        assert len(aset.alns) < n0
+        assert aset.bin_bases.max() <= 5 * p.bin_size + 20
+        # survivors are the highest-scoring ones
+        assert min(a.score for a in aset.alns) >= 100 + 30 - len(aset.alns)
+
+
+class TestSam2Cns:
+    def _sam_text_consensus(self):
+        """Ref with one error; 5 exact short reads voting it away."""
+        true = "ACGTACGTAGCCATGCATGGATCGATCGTTAGCCATGGACTACGATCGTAGCTAGCA" * 3
+        ref = true[:80] + "T" + true[81:]        # one substitution
+        lines = []
+        for i in range(5):
+            st = 40 + i * 8
+            seq = true[st:st + 60]
+            lines.append("\t".join([
+                f"s{i}", "0", "lr", str(st + 1), "60", "60M", "*", "0", "0",
+                seq, "I" * 60, "AS:i:300"]))
+        return ref, true, "\n".join(lines) + "\n"
+
+    def test_consensus_corrects_error(self, tmp_path):
+        ref, true, text = self._sam_text_consensus()
+        p = str(tmp_path / "in.sam")
+        with open(p, "w") as fh:
+            fh.write("@SQ\tSN:lr\tLN:%d\n" % len(ref))
+            fh.write(text)
+        refs = [SeqRecord("lr", ref, qual=np.full(len(ref), 5, np.uint8))]
+        cfg = Sam2CnsConfig(params=ConsensusParams(
+            indel_taboo_length=7, use_ref_qual=True))
+        out, chim = sam2cns_records(p, refs, cfg)
+        assert len(out) == 1
+        assert out[0].seq[80].upper() == true[80]
+
+    def test_unmapped_ref_passthrough(self, tmp_path):
+        p = str(tmp_path / "empty.sam")
+        with open(p, "w") as fh:
+            fh.write("@SQ\tSN:lr\tLN:40\n")
+        refs = [SeqRecord("lr", "ACGT" * 10,
+                          qual=np.full(40, 9, np.uint8))]
+        out, _ = sam2cns_records(p, refs, Sam2CnsConfig(
+            params=ConsensusParams(use_ref_qual=True)))
+        assert len(out) == 1
+        assert out[0].seq.upper() == "ACGT" * 10
+        assert len(out[0].seq) == 40
+
+    def test_unresolved_secondary_dropped(self, tmp_path):
+        """Secondary with '*' seq whose primary never streams (e.g. it maps
+        to a read outside this chunk) must be skipped, not crash."""
+        p = str(tmp_path / "sec.sam")
+        with open(p, "w") as fh:
+            fh.write("@SQ\tSN:lr\tLN:40\n")
+            fh.write("q1\t256\tlr\t1\t0\t20M\t*\t0\t0\t*\t*\tAS:i:90\n")
+        refs = [SeqRecord("lr", "ACGT" * 10, qual=np.full(40, 9, np.uint8))]
+        out, _ = sam2cns_records(p, refs, Sam2CnsConfig(
+            params=ConsensusParams(use_ref_qual=True)))
+        assert len(out) == 1 and len(out[0].seq) == 40
+
+    def test_mcr_parsing(self):
+        assert parse_mcrs("MCR0:10,20 MCR1:50,5 HPL:30") == [(10, 20),
+                                                             (50, 5)]
+        assert parse_mcrs("") == []
