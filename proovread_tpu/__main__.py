@@ -1,0 +1,7 @@
+"""``python -m proovread_tpu`` — the CLI entry point."""
+
+import sys
+
+from proovread_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,187 @@
+"""Command-line driver — the role of ``bin/proovread``'s CLI + output layer.
+
+Mirrors the reference flags (``bin/proovread:137-298``): ``-l`` long reads,
+``-s`` short reads, ``-u`` unitigs, ``-p/--pre`` output prefix, ``-m`` mode
+(auto-detected otherwise, ``:628-654``), ``--sam``/``--bam`` external-mapping
+re-entry (``:718-736``), ``-c/--cfg`` user config, ``--create-cfg``.
+
+Outputs (reference layout, ``bin/proovread:904-956``):
+``<pre>/<name>.untrimmed.fq``, ``.trimmed.fq``, ``.trimmed.fa``,
+``.ignored.tsv``, ``.chim.tsv``, plus ``.parameter.log`` (``:401-416``) and
+per-task wall-times on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+log = logging.getLogger("proovread_tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="proovread-tpu",
+        description="TPU-native hybrid correction of PacBio long reads by "
+                    "iterative short-read consensus (proovread rebuild).")
+    ap.add_argument("-l", "--long-reads", action="append", default=[],
+                    help="long-read FASTQ/FASTA (repeatable)")
+    ap.add_argument("-s", "--short-reads", action="append", default=[],
+                    help="short-read FASTQ/FASTA (repeatable)")
+    ap.add_argument("-u", "--unitigs", action="append", default=[],
+                    help="unitig FASTA (enables utg tasks)")
+    ap.add_argument("-p", "--pre", help="output directory/prefix")
+    ap.add_argument("-m", "--mode", default="auto",
+                    help="correction mode (auto|sr|mr|*-noccs|*+utg|sam|bam)")
+    ap.add_argument("--sam", help="external SAM mapping (re-entry mode)")
+    ap.add_argument("--bam", help="external BAM mapping (re-entry mode)")
+    ap.add_argument("-c", "--cfg", help="user config file (JSON + // comments)")
+    ap.add_argument("--create-cfg", metavar="PATH",
+                    help="write a commented config template and exit")
+    ap.add_argument("--coverage", type=float,
+                    help="input short-read coverage estimate")
+    ap.add_argument("-t", "--threads", type=int, default=1,
+                    help="accepted for interface parity; parallelism comes "
+                         "from the device mesh")
+    ap.add_argument("--lr-min-length", type=int,
+                    help="min long-read length (0 disables; default 2x "
+                         "median short-read length)")
+    ap.add_argument("--no-sampling", action="store_true",
+                    help="use all short reads every iteration")
+    ap.add_argument("--overwrite", action="store_true",
+                    help="allow writing into a non-empty output dir")
+    ap.add_argument("--keep-temporary-files", action="store_true")
+    ap.add_argument("--debug", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    return ap
+
+
+def _read_records(paths: List[str]):
+    from proovread_tpu.io import fasta, fastq
+    out = []
+    for p in paths:
+        rd = (fastq.FastqReader(p) if _looks_fastq(p)
+              else fasta.FastaReader(p))
+        out.extend(rd)
+    return out
+
+
+def _looks_fastq(path: str) -> bool:
+    import gzip
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as fh:
+        first = fh.read(1)
+    return first == b"@"
+
+
+def _have_subreads(records) -> bool:
+    """PacBio subread id auto-detection (bin/proovread:1512-1517)."""
+    from proovread_tpu.pipeline.ccs import is_subread_set
+    return is_subread_set(records)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=(logging.DEBUG if args.debug
+               else logging.ERROR if args.quiet else logging.INFO),
+        format="[%(asctime)s] %(message)s", datefmt="%H:%M:%S")
+
+    from proovread_tpu.config import Config, mode_auto
+
+    if args.create_cfg:
+        Config.create_template(args.create_cfg)
+        print(f"config template written to {args.create_cfg}")
+        return 0
+
+    if not args.long_reads:
+        print("error: -l/--long-reads is required", file=sys.stderr)
+        return 2
+    if not (args.short_reads or args.unitigs or args.sam or args.bam):
+        print("error: need -s, -u, --sam or --bam", file=sys.stderr)
+        return 2
+    if not args.pre:
+        print("error: -p/--pre is required", file=sys.stderr)
+        return 2
+
+    cfg = Config.load(args.cfg)
+
+    outdir = args.pre
+    os.makedirs(outdir, exist_ok=True)
+    if os.listdir(outdir) and not args.overwrite:
+        print(f"error: output dir {outdir!r} not empty "
+              "(use --overwrite)", file=sys.stderr)
+        return 2
+    name = os.path.basename(outdir.rstrip("/")) or "proovread"
+
+    t_start = time.time()
+    longs = _read_records(args.long_reads)
+    shorts = _read_records(args.short_reads) if args.short_reads else []
+    utgs = _read_records(args.unitigs) if args.unitigs else []
+
+    sr_lens = np.array([len(r) for r in shorts]) if shorts else np.zeros(0)
+    min_sr_len = int(np.median(sr_lens)) if len(sr_lens) else 0
+
+    mode = args.mode
+    if mode == "auto":
+        mode = mode_auto(min_sr_len, bool(utgs), _have_subreads(longs),
+                         sam=bool(args.sam), bam=bool(args.bam))
+    tasks = cfg.tasks(mode)
+    log.info("mode %s: tasks %s", mode, " ".join(tasks))
+
+    # parameter.log (bin/proovread:401-416)
+    with open(os.path.join(outdir, f"{name}.parameter.log"), "w") as fh:
+        fh.write(json.dumps({
+            "argv": sys.argv if argv is None else ["proovread-tpu"] + argv,
+            "mode": mode, "tasks": tasks,
+            "n_long_reads": len(longs), "n_short_reads": len(shorts),
+            "n_unitigs": len(utgs), "median_sr_len": min_sr_len,
+            "config": cfg.data,
+        }, indent=2))
+
+    from proovread_tpu.pipeline import run_tasks
+    result = run_tasks(
+        cfg, mode, tasks, longs, shorts, utgs,
+        sam=args.sam, bam=args.bam, coverage=args.coverage,
+        lr_min_length=args.lr_min_length,
+        sampling=not args.no_sampling)
+
+    # -- reference output layout (bin/proovread:904-956) -----------------
+    from proovread_tpu.io.fasta import FastaWriter
+    from proovread_tpu.io.fastq import FastqWriter
+
+    def _w(path, records, fq=True):
+        with open(os.path.join(outdir, path), "wb") as fh:
+            w = FastqWriter(fh) if fq else FastaWriter(fh)
+            for r in records:
+                w.write(r)
+
+    _w(f"{name}.untrimmed.fq", result.untrimmed)
+    _w(f"{name}.trimmed.fq", result.trimmed)
+    _w(f"{name}.trimmed.fa", result.trimmed, fq=False)
+    with open(os.path.join(outdir, f"{name}.ignored.tsv"), "w") as fh:
+        for rid, why in result.ignored:
+            fh.write(f"{rid}\t{why}\n")
+    with open(os.path.join(outdir, f"{name}.chim.tsv"), "w") as fh:
+        for rid, f0, t0, s in result.chimera:
+            fh.write(f"{rid}\t{f0}\t{t0}\t{s:.3f}\n")
+
+    for rep in result.reports:
+        log.info("task %-16s masked/supported %5.1f%%  candidates %d",
+                 rep.task, rep.masked_frac * 100, rep.n_candidates)
+    log.info("done: %d corrected, %d trimmed, %d ignored, %d chimera "
+             "(%.1fs)", len(result.untrimmed), len(result.trimmed),
+             len(result.ignored), len(result.chimera),
+             time.time() - t_start)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

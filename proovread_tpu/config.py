@@ -1,0 +1,200 @@
+"""Config system — the role of ``proovread.cfg`` + ``lib/Cfg.pm`` +
+``bin/proovread``'s ``cfg()`` resolver.
+
+The reference's config is an executable Perl hash with three load-bearing
+behaviors this module reproduces: (1) **config IS the pipeline definition**
+(``mode-tasks`` maps mode names to task lists, ``proovread.cfg:105-142``);
+(2) **task-scoped resolution**: a key may hold a plain value or a
+``{DEF, task: override}`` map, looked up by task id with trailing-counter
+stripping (``bwa-sr-3`` falls back to ``bwa-sr``) and DEF fallback
+(``bin/proovread:1989-2024``); (3) **layering**: built-in defaults <- user
+config file <- CLI flags (``bin/proovread:96-126``).
+
+File format: JSON with ``//`` line comments (a data format, not executable
+code — deliberate deviation from the Perl ``do``-file; documented in
+``create_template``).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+# Built-in defaults. Semantic parity with proovread.cfg:105-302; values are
+# config parity (category b), not code.
+DEFAULTS: Dict[str, Any] = {
+    "mode-tasks": {
+        "sr": ["read-long", "ccs-1", "bwa-sr-1", "bwa-sr-2", "bwa-sr-3",
+               "bwa-sr-4", "bwa-sr-5", "bwa-sr-6", "bwa-sr-finish"],
+        "mr": ["read-long", "ccs-1", "bwa-mr-1", "bwa-mr-2", "bwa-mr-3",
+               "bwa-mr-4", "bwa-mr-5", "bwa-mr-6", "bwa-mr-finish"],
+        "sr+utg": ["read-long", "ccs-1", "utg", "bwa-sr-1", "bwa-sr-2",
+                   "bwa-sr-3", "bwa-sr-4", "bwa-sr-5", "bwa-sr-6",
+                   "bwa-sr-finish"],
+        "mr+utg": ["read-long", "ccs-1", "utg", "bwa-mr-1", "bwa-mr-2",
+                   "bwa-mr-3", "bwa-mr-4", "bwa-mr-5", "bwa-mr-6",
+                   "bwa-mr-finish"],
+        "sr-noccs": ["read-long", "bwa-sr-1", "bwa-sr-2", "bwa-sr-3",
+                     "bwa-sr-4", "bwa-sr-5", "bwa-sr-6", "bwa-sr-finish"],
+        "mr-noccs": ["read-long", "bwa-mr-1", "bwa-mr-2", "bwa-mr-3",
+                     "bwa-mr-4", "bwa-mr-5", "bwa-mr-6", "bwa-mr-finish"],
+        "sr+utg-noccs": ["read-long", "utg", "bwa-sr-1", "bwa-sr-2",
+                         "bwa-sr-3", "bwa-sr-4", "bwa-sr-5", "bwa-sr-6",
+                         "bwa-sr-finish"],
+        "mr+utg-noccs": ["read-long", "utg", "bwa-mr-1", "bwa-mr-2",
+                         "bwa-mr-3", "bwa-mr-4", "bwa-mr-5", "bwa-mr-6",
+                         "bwa-mr-finish"],
+        "sam": ["read-long", "read-sam"],
+        "bam": ["read-long", "read-bam"],
+        "utg": ["read-long", "ccs-1", "utg"],
+        "utg-noccs": ["read-long", "utg"],
+    },
+    "sr-coverage": {"DEF": 15,
+                    "bwa-sr-finish": 30, "bwa-mr-finish": 30},
+    "sr-chunk-number": 1000,
+    "sr-chunk-step": 20,
+    "sr-trim": 1,
+    "sr-indel-taboo-length": 7,
+    "sr-indel-taboo": 0.1,
+    "detect-chimera": {"DEF": 0, "bwa-sr-finish": 1, "bwa-mr-finish": 1,
+                       "read-sam": 1, "read-bam": 1},
+    # phred-min,phred-max,mask-min-len,unmask-min-len,mask-reduce,end-ratio
+    "hcr-mask": {"DEF": "20,41,80,130,60,0.7",
+                 "bwa-sr-4": "20,41,80,130,60,0.3",
+                 "bwa-sr-5": "20,41,80,130,60,0.3",
+                 "bwa-sr-6": "20,41,80,130,60,0.3",
+                 "bwa-mr-4": "20,41,80,130,60,0.3",
+                 "bwa-mr-5": "20,41,80,130,60,0.3",
+                 "bwa-mr-6": "20,41,80,130,60,0.3"},
+    "mask-shortcut-frac": 0.92,
+    "mask-min-gain-frac": 0.03,
+    "chunk-size": 100,
+    "coverage-scale-factor": 0.75,
+    "bin-size": {"DEF": 20},
+    "max-coverage": {"DEF": 50},
+    "rep-coverage": {"DEF": 0, "utg": 7},
+    "min-ncscore": {"DEF": None, "utg": 3.3},
+    "qual-weighted": {"DEF": 0, "utg": 1, "ccs-1": 1},
+    "fallback-phred": {"DEF": 1, "utg": 30},
+    "max-ins-length": {"DEF": 0, "utg": 10},
+    "seq-filter": {"--trim-win": "12,5", "--min-length": 500},
+    "chimera-filter": {"--min-score": 0.2, "--trim-length": 20},
+    "siamaera": {},            # set to None to deactivate
+    "ccs": {"--min-subreads": 2},
+    "lr-min-length": None,     # default: 2 x median sr length
+    "utg-window": 512,         # unitig query windowing for the banded kernel
+    "utg-overlap": 64,
+    # engine knobs (TPU additions; no reference counterpart)
+    "engine": "device",
+    "batch-reads": 128,
+    "device-chunk": 8192,
+    "seed-stride": 8,
+}
+
+_COMMENT_RE = re.compile(r"^\s*//.*$", re.M)
+_TRAILING_COMMA_RE = re.compile(r",(\s*[}\]])")
+_CTR_RE = re.compile(r"-\d+$")
+
+
+class Config:
+    """Layered, task-scoped configuration."""
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None):
+        self.data: Dict[str, Any] = json.loads(json.dumps(DEFAULTS))
+        if data:
+            self.update(data)
+
+    # -- layering ---------------------------------------------------------
+    def update(self, other: Dict[str, Any]) -> None:
+        """Merge a layer: scalar keys replace; dict values merge key-wise
+        (so a user file can override just ``{"DEF": ...}``)."""
+        for k, v in other.items():
+            if (isinstance(v, dict) and isinstance(self.data.get(k), dict)):
+                self.data[k].update(v)
+            else:
+                self.data[k] = v
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "Config":
+        cfg = cls()
+        if path:
+            text = _COMMENT_RE.sub("", open(path).read())
+            # tolerate trailing commas: uncommenting a single template line
+            # legitimately leaves one before the closing brace
+            text = _TRAILING_COMMA_RE.sub(r"\1", text)
+            cfg.update(json.loads(text))
+        return cfg
+
+    # -- task-scoped resolution (bin/proovread:1989-2024) ----------------
+    def get(self, key: str, task: Optional[str] = None, default=None):
+        """Resolve ``key``: plain values return as-is; ``{DEF, task: v}``
+        maps resolve by exact task id, then with the trailing ``-N``
+        counter stripped, then DEF."""
+        if key not in self.data:
+            key = _CTR_RE.sub("", key)
+            if key not in self.data:
+                return default
+        v = self.data[key]
+        if not isinstance(v, dict) or "DEF" not in v:
+            return v
+        out = v.get("DEF", default)
+        if task is not None:
+            if task in v:
+                out = v[task]
+            else:
+                base = _CTR_RE.sub("", task)
+                if base in v:
+                    out = v[base]
+        return out
+
+    def tasks(self, mode: str) -> List[str]:
+        mt = self.data["mode-tasks"]
+        if mode not in mt:
+            raise ValueError(
+                f"unknown mode {mode!r} (known: {', '.join(sorted(mt))})")
+        return list(mt[mode])
+
+    # -- template ---------------------------------------------------------
+    def dump(self) -> str:
+        return json.dumps(self.data, indent=2)
+
+    @staticmethod
+    def create_template(path: str) -> None:
+        """Emit a fully-commented config template (every line commented out,
+        like the reference's --create-cfg, ``bin/proovread:1779-1799``)."""
+        body = json.dumps(DEFAULTS, indent=2)
+        lines = ["// proovread-tpu configuration template.",
+                 "// Uncomment and edit keys to override built-in defaults;",
+                 "// dict-valued keys merge key-wise ({\"DEF\": ...} +",
+                 "// per-task overrides, resolved with -N counter stripping).",
+                 "// Uncomment WHOLE key blocks (a multi-line value needs",
+                 "// all its lines); trailing commas are tolerated.",
+                 "{"]
+        for ln in body.split("\n")[1:-1]:
+            lines.append("//" + ln)
+        lines.append("}")
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+
+
+def mode_auto(min_sr_len: Optional[int], have_utg: bool,
+              have_subreads: bool, sam: bool = False,
+              bam: bool = False) -> str:
+    """Mode auto-detection (bin/proovread:625-654 + noccs fallback
+    :1512-1517)."""
+    if bam:
+        return "bam"
+    if sam:
+        return "sam"
+    if not min_sr_len:
+        mode = "utg" if have_utg else "sr"
+    elif min_sr_len > 150:
+        mode = "mr"
+    else:
+        mode = "sr"
+    if have_utg and "utg" not in mode:
+        mode += "+utg"
+    if not have_subreads and mode not in ("sam", "bam"):
+        mode += "-noccs"
+    return mode

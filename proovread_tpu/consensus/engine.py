@@ -307,35 +307,29 @@ def assemble_consensus(
     )
 
 
-def chimera_scan(bin_bases, L, params, res, cover, select) -> List[Tuple[int, int, float]]:
-    """Shared chimera core (Sam/Seq.pm:774-888): low-fill bin runs ->
-    left/right flanking state matrices -> per-column entropy delta.
-
-    ``select(fl, tl, fr, tr)`` returns (left, right) lists of
-    :class:`ColumnStates` for alignments whose bin falls in those ranges —
-    host-expanded by the engine, lazily expanded by the fused path."""
+def chimera_runs(bin_bases, L, params, cover) -> List[Tuple[int, ...]]:
+    """Geometry stage of the chimera scan (Sam/Seq.pm:774-812): runs of 1-4
+    low-fill bins away from the 5 terminal bins, fully covered, with their
+    window/flank coordinates. Returns (mat_from, mat_to, fl, tl, fr, tr)
+    per candidate breakpoint region."""
     p = params
     if bin_bases is None or len(bin_bases) <= 20:
         return []
     thr = p.bin_max_bases / 5 + 1
 
-    # runs of 1-4 consecutive low-coverage bins, skipping 5 terminal bins
-    runs = []
+    raw = []
     lcov = 0
     for i in range(5, len(bin_bases) - 5):
         if bin_bases[i] <= thr:
             lcov += 1
         else:
             if 1 <= lcov < 5:
-                runs.append((i - lcov, i - 1))
+                raw.append((i - lcov, i - 1))
             lcov = 0
-    if not runs:
-        return []
 
-    emit_counts_prefix = None
-    out = []
     bs = p.bin_size
-    for (r0, r1) in runs:
+    out = []
+    for (r0, r1) in raw:
         mat_from = (r0 - 1) * bs
         mat_to = (r1 + 2) * bs - 1
         if mat_from < 0 or mat_to >= L:
@@ -344,13 +338,22 @@ def chimera_scan(bin_bases, L, params, res, cover, select) -> List[Tuple[int, in
             continue
         fl, tr = r0 - 4, r1 + 5
         delta = (tr - fl - 1) // 2
-        tl, fr = fl + delta, tr - delta
+        out.append((mat_from, mat_to, fl, fl + delta, tr - delta, tr))
+    return out
 
-        sel_l, sel_r = select(fl, tl, fr, tr)
+
+def chimera_score(runs, counts_fn, res, L, params
+                  ) -> List[Tuple[int, int, float]]:
+    """Entropy stage (Sam/Seq.pm:844-888): per run, per-column entropy of
+    the combined window minus the max flank entropy; score = fraction of
+    columns with delta > 0.7. ``counts_fn(mat_from, Wn, fl, tl, fr, tr)``
+    returns the ([Wn, S+1], [Wn, S+1]) left/right state-count matrices."""
+    emit_counts_prefix = None
+    out = []
+    bs = params.bin_size
+    for (mat_from, mat_to, fl, tl, fr, tr) in runs:
         Wn = mat_to + 1 - mat_from
-        cl = window_counts(sel_l, mat_from, Wn)
-        cr = window_counts(sel_r, mat_from, Wn)
-
+        cl, cr = counts_fn(mat_from, Wn, fl, tl, fr, tr)
         hx_delta = []
         for c in range(Wn):
             lcol, rcol = cl[c], cr[c]
@@ -365,6 +368,23 @@ def chimera_scan(bin_bases, L, params, res, cover, select) -> List[Tuple[int, in
             emit_counts_prefix = emit_prefix(res, L)
         out.append((int(emit_counts_prefix[f]), int(emit_counts_prefix[t]), score))
     return out
+
+
+def chimera_scan(bin_bases, L, params, res, cover, select) -> List[Tuple[int, int, float]]:
+    """Chimera core (Sam/Seq.pm:774-888) in terms of the two stages above.
+
+    ``select(fl, tl, fr, tr)`` returns (left, right) lists of
+    :class:`ColumnStates` for alignments whose bin falls in those ranges."""
+    runs = chimera_runs(bin_bases, L, params, cover)
+    if not runs:
+        return []
+
+    def counts_fn(mat_from, Wn, fl, tl, fr, tr):
+        sel_l, sel_r = select(fl, tl, fr, tr)
+        return (window_counts(sel_l, mat_from, Wn),
+                window_counts(sel_r, mat_from, Wn))
+
+    return chimera_score(runs, counts_fn, res, L, params)
 
 
 def window_counts(sel: Sequence[ColumnStates], mat_from: int, Wn: int) -> np.ndarray:

@@ -1,20 +1,17 @@
-"""Multi-chip parallelism: device meshes and sharded correction steps.
+"""Multi-chip parallelism: the data-parallel device mesh.
 
 The reference's outermost parallelism is share-nothing job-level chunking of
-the long-read set (SURVEY §2.3); here that becomes a 2D
-``jax.sharding.Mesh``: the ``dp`` axis shards long reads / alignment
-candidates across chips (ICI), and ``sp`` shards the long-read length axis
-of the pileup/consensus tensors (sequence parallelism). Collectives are
-inserted by GSPMD; the only cross-shard traffic is candidate->read scatter
-and scalar metric reductions, matching the reference's "filesystem
-interconnect" being limited to chunk merge + global masked-% stats
-(``bin/proovread:1640-1718``).
+the long-read set (SURVEY §2.3); here that becomes a ``jax.sharding.Mesh``
+whose ``dp`` axis shards long reads across chips, with short reads
+replicated. Each chip runs the SAME fused correction pass the single-chip
+pipeline runs; the only interconnect traffic is the scalar iteration KPIs
+(``psum``), matching the reference's "filesystem interconnect" being
+limited to chunk merge + global masked-% stats (``bin/proovread:1640-1718``).
 """
 
-from proovread_tpu.parallel.mesh import (
-    make_mesh,
-    shard_batch,
-    sharded_call_consensus,
+from proovread_tpu.parallel.dmesh import (
+    make_dp_mesh,
+    sharded_iteration_step,
 )
 
-__all__ = ["make_mesh", "shard_batch", "sharded_call_consensus"]
+__all__ = ["make_dp_mesh", "sharded_iteration_step"]

@@ -1,0 +1,118 @@
+"""Multi-chip sharding of the device correction pass.
+
+The reference's outermost parallelism is job-level data parallelism: long
+reads are split into chunks and each chunk is an independent process
+(``README.org:59-78``, SURVEY §2.3 row 1). The TPU-native equivalent shards
+the long-read batch across the mesh's ``dp`` axis with the short-read batch
+replicated: every device runs the SAME fused pass (seeding -> banded SW ->
+admission -> pileup -> consensus -> assembly -> HCR mask) on its local read
+shard — the identical code path the single-chip pipeline runs
+(``pipeline/dcorrect.py:_fused_pass_body``) — and only the two iteration
+KPIs (masked bases, admitted count) cross the interconnect, as ``psum``
+scalars. There is no other communication: the problem is embarrassingly
+parallel over reads, so ICI carries O(1) bytes per pass.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from proovread_tpu.align import bsw, dseed
+from proovread_tpu.align.params import AlignParams
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.ops.encode import N
+from proovread_tpu.pipeline.dcorrect import (_fused_pass_body,
+                                             device_assemble,
+                                             device_hcr_mask)
+from proovread_tpu.pipeline.masking import MaskParams
+
+
+def make_dp_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), ("dp",))
+
+
+def sharded_iteration_step(
+    mesh: Mesh,
+    ap: AlignParams,
+    cns: ConsensusParams,
+    mask_params: MaskParams,
+    Lp: int,
+    m: int,
+    chunks_per_shard: int = 2,
+    chunk: int = 8192,
+    seed_stride: int = 8,
+    seed_min_votes: int = 2,
+    interpret: Optional[bool] = None,
+):
+    """Build the jitted multi-chip iteration step.
+
+    Returns ``step(codes, qual, lengths, mask_cols, qc, rcq, qq, qlen) ->
+    (new_codes, new_qual, new_lengths, new_mask, masked_frac, n_admitted)``
+    with the read tensors sharded over ``dp`` and queries replicated.
+
+    ``chunks_per_shard`` statically caps per-shard candidates at
+    ``chunks_per_shard * chunk`` (a shard_map body cannot size its chunk
+    loop from a traced candidate count the way the single-chip driver
+    does); overflow candidates are dropped deterministically from the
+    compacted tail.
+    """
+    W = bsw.band_lanes(ap)
+    CH = chunk
+    n_chunks = chunks_per_shard
+    R_need = n_chunks * CH
+    itp = bsw.default_interpret() if interpret is None else interpret
+
+    def local_step(codes, qual, lengths, mask_cols, qc, rcq, qq, qlen):
+        map_codes = jnp.where(mask_cols, jnp.int8(N), codes)
+        index = dseed.device_index(map_codes, lengths, ap.min_seed_len)
+        cand = dseed.probe_candidates(
+            index, qc, qlen, rcq, ap,
+            stride=seed_stride, min_votes=seed_min_votes)
+        sread, strand, lread, diag, n_valid = \
+            dseed.compact_candidates(cand)
+        R0 = sread.shape[0]
+        if R_need > R0:
+            padn = R_need - R0
+            sread = jnp.concatenate([sread, jnp.zeros(padn, sread.dtype)])
+            strand = jnp.concatenate([strand,
+                                      jnp.zeros(padn, strand.dtype)])
+            lread = jnp.concatenate(
+                [lread, jnp.broadcast_to(lread[-1], (padn,))])
+            diag = jnp.concatenate([diag, jnp.zeros(padn, diag.dtype)])
+        n_cand = jnp.minimum(n_valid, R_need)
+
+        call, n_admitted, _, _ = _fused_pass_body(
+            map_codes.reshape(-1), mask_cols.reshape(-1),
+            codes, qual, lengths, qc, rcq, qq, qlen,
+            sread[:R_need], strand[:R_need], lread[:R_need],
+            diag[:R_need], n_cand,
+            m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
+            interpret=itp, collect=False)
+
+        new_codes, new_qual, new_len = device_assemble(
+            call, qual, lengths, Lp)
+        new_mask, _ = device_hcr_mask(new_qual, new_len, mask_params)
+
+        masked = jax.lax.psum(jnp.sum(new_mask), "dp")
+        total = jax.lax.psum(jnp.maximum(jnp.sum(new_len), 1), "dp")
+        n_adm = jax.lax.psum(n_admitted, "dp")
+        frac = masked / total
+        return new_codes, new_qual, new_len, new_mask, frac, n_adm
+
+    shard = P("dp")
+    repl = P()
+    mapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(shard, shard, shard, shard, repl, repl, repl, repl),
+        out_specs=(shard, shard, shard, shard, repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

@@ -9,6 +9,7 @@ from proovread_tpu.pipeline.masking import MaskParams, hcr_intervals, mask_batch
 from proovread_tpu.pipeline.sampling import CoverageSampler
 from proovread_tpu.pipeline.sam2cns import (Sam2CnsConfig, sam2cns,
                                             sam2cns_records)
+from proovread_tpu.pipeline.tasks import run_tasks
 from proovread_tpu.pipeline.trim import TrimParams, trim_records
 
 __all__ = [
@@ -17,4 +18,5 @@ __all__ = [
     "MaskParams", "hcr_intervals", "mask_batch",
     "CoverageSampler", "TrimParams", "trim_records",
     "Sam2CnsConfig", "sam2cns", "sam2cns_records",
+    "run_tasks",
 ]

@@ -226,7 +226,8 @@ class DevicePassStats:
 class AlnData:
     """Host-side view of one pass's admitted candidates, for the chimera
     entropy scan (``bin/bam2cns:461-491``). Expanded column slabs stay on
-    device; ``column_states`` fetches them lazily per chunk."""
+    device; ``prefetch`` pulls the needed rows in one transfer and
+    ``live_columns`` exposes their gated window columns."""
     lread: np.ndarray       # i32 [R]
     pos0: np.ndarray        # i32 [R]
     span: np.ndarray        # i32 [R]
@@ -266,44 +267,39 @@ class AlnData:
             for j, ci in enumerate(group):
                 self._rows[ci] = (st[j], qr[j], il[j])
 
-    def column_states(self, ci: int):
-        """Expanded :class:`ColumnStates` of candidate ``ci`` (or None),
-        taboo-trimmed with the same per-column gate as ``build_votes``.
-        Insertion-base identities are not reconstructed (the chimera scan
-        only consumes state counts and has-insertion flags)."""
-        from proovread_tpu.consensus.cigar import ColumnStates
-
+    def live_columns(self, ci: int, taboo_abs: int):
+        """(global_cols, states, has_ins) of candidate ``ci``'s live window
+        columns — the same per-column gate ``build_votes`` applies (state
+        present + query position inside the taboo-trimmed span). The single
+        source of truth for host-side column expansion (used by the chimera
+        scan's window counts)."""
         ci = int(ci)
         if ci not in self._rows:
             self.prefetch([ci])
         st, qr, il = self._rows[ci]
         cns = self.cns
         aln_len = int(self.q_end[ci] - self.q_start[ci])
-        taboo = (cns.indel_taboo_length if cns.indel_taboo_length
+        taboo = (taboo_abs if taboo_abs
                  else int(aln_len * cns.indel_taboo + 0.5))
-        kept_lo = self.q_start[ci] + taboo
-        kept_hi = self.q_end[ci] - taboo
-        live = (st >= 0) & (qr >= kept_lo) & (qr < kept_hi)
-        idx = np.flatnonzero(live)
-        if idx.size == 0:
-            return None
-        a, b = int(idx[0]), int(idx[-1]) + 1
-        span = b - a
-        K = cns.ins_cap
-        return ColumnStates(
-            rpos=int(self.win_start[ci]) + a,
-            state=np.clip(st[a:b], 0, None).astype(np.int8),
-            freq=np.ones(span, np.float32),
-            ins_len=np.clip(il[a:b], 0, K).astype(np.int16),
-            ins_bases=np.zeros((span, K), np.int8),
-        )
+        col = int(self.win_start[ci]) + np.arange(len(st))
+        live = ((st >= 0)
+                & (qr >= self.q_start[ci] + taboo)
+                & (qr < self.q_end[ci] - taboo))
+        return col[live], st[live], (il[live] > 0)
 
 
 def detect_chimera_device(results, ref_lens: np.ndarray, aln: AlnData) -> None:
     """Chimera scan over a device pass's admitted candidates — the device-path
-    twin of ``FastCorrector._detect_chimera`` (same ``chimera_scan`` core,
-    ``Sam/Seq.pm:774-888``). Fills each ``results[b].chimera``."""
-    from proovread_tpu.consensus.engine import chimera_scan
+    twin of ``FastCorrector._detect_chimera`` (same geometry/entropy core,
+    ``Sam/Seq.pm:774-888``). Fills each ``results[b].chimera``.
+
+    Cost discipline for the tunneled device: the run geometry (bin fill,
+    coverage, terminal skips) is decided entirely from host-side scalars, so
+    only candidates whose bin falls inside an actual run window have their
+    expanded slabs fetched — one transfer for all reads — and the window
+    state counts are built vectorized over those slabs."""
+    from proovread_tpu.consensus.engine import (chimera_runs, chimera_score)
+    from proovread_tpu.ops.encode import N_STATES
 
     cns = aln.cns
     bs = cns.bin_size
@@ -315,41 +311,56 @@ def detect_chimera_device(results, ref_lens: np.ndarray, aln: AlnData) -> None:
     pos0 = aln.pos0
     bins = np.clip(((pos0 + 1 + span / 2) // bs).astype(np.int64), 0, None)
 
-    # quick bin screen first, so one batched prefetch covers every read
-    # that will actually be scanned
-    screened = []
+    # geometry per read, from host scalars only
+    scans = []
+    needed: List[np.ndarray] = []
     for b in range(len(results)):
         L_i = int(ref_lens[b])
         mine = adm_idx[aln.lread[adm_idx] == b]
         if mine.size == 0:
             continue
         n_bins = L_i // bs + 1
+        if n_bins <= 20:
+            continue
         bb = np.bincount(np.clip(bins[mine], 0, n_bins - 1),
                          weights=span[mine].astype(np.float64),
                          minlength=n_bins)
-        if n_bins <= 20 or not (bb[5:-5] <= cns.bin_max_bases / 5 + 1).any():
+        if not (bb[5:-5] <= cns.bin_max_bases / 5 + 1).any():
             continue
-        screened.append((b, L_i, mine, bb))
-    if not screened:
+        diff = np.zeros(L_i + 1)
+        np.add.at(diff, np.clip(pos0[mine], 0, L_i), 1)
+        np.add.at(diff, np.clip(pos0[mine] + span[mine], 0, L_i), -1)
+        cover = np.cumsum(diff[:L_i])
+        runs = chimera_runs(bb, L_i, cns, cover)
+        if not runs:
+            continue
+        lo = min(r[2] for r in runs)
+        hi = max(r[5] for r in runs)
+        sel = mine[(bins[mine] >= lo) & (bins[mine] <= hi)]
+        scans.append((b, L_i, mine, runs))
+        needed.append(sel)
+    if not scans:
         return
-    aln.prefetch(np.concatenate([m for _, _, m, _ in screened]))
+    aln.prefetch(np.concatenate(needed))
 
-    for b, L_i, mine, bb in screened:
-        cover = np.zeros(L_i)
-        for ci in mine:
-            a, e = max(0, int(pos0[ci])), min(L_i, int(pos0[ci] + span[ci]))
-            cover[a:e] += 1
+    taboo_abs = cns.indel_taboo_length or 0
+    for b, L_i, mine, runs in scans:
 
-        def select(fl, tl, fr, tr, mine=mine):
-            sel_l = [aln.column_states(ci) for ci in mine
-                     if fl <= bins[ci] <= tl]
-            sel_r = [aln.column_states(ci) for ci in mine
-                     if fr <= bins[ci] <= tr]
-            return ([c for c in sel_l if c is not None],
-                    [c for c in sel_r if c is not None])
+        def counts_fn(mat_from, Wn, fl, tl, fr, tr, mine=mine):
+            def side(f, t):
+                counts = np.zeros((Wn, N_STATES + 1), np.float64)
+                cis = mine[(bins[mine] >= f) & (bins[mine] <= t)]
+                for ci in cis:
+                    col, st, has_ins = aln.live_columns(ci, taboo_abs)
+                    inw = (col >= mat_from) & (col < mat_from + Wn)
+                    cls = np.where(has_ins, N_STATES, st).astype(np.int64)
+                    np.add.at(counts,
+                              (col[inw] - mat_from, cls[inw]), 1.0)
+                return counts
+            return side(fl, tl), side(fr, tr)
 
-        results[b].chimera = chimera_scan(bb, L_i, cns, results[b], cover,
-                                          select)
+        results[b].chimera = chimera_score(runs, counts_fn, results[b],
+                                           L_i, cns)
 
 
 @functools.partial(
@@ -393,6 +404,103 @@ def _gather_and_align(map_flat, q_codes, rc_codes, q_qual, q_lengths,
     return res, q, qual, win_start, passed, pos0, span, ignore_cols
 
 
+def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
+                     q_codes, rc_codes, q_qual, q_lengths,
+                     sread, strand, lread, diag, n_cand,
+                     m: int, W: int, CH: int, n_chunks: int,
+                     ap: AlignParams, cns: ConsensusParams,
+                     interpret: bool, collect: bool):
+    """One full correction pass as a SINGLE XLA program.
+
+    The sub-ops (bsw kernel, vote packing, pileup scatter, consensus call)
+    each run in well under a millisecond on the chip; dispatched one by one
+    through the tunneled runtime, the pass was dispatch-bound at ~300ms per
+    chunk. Tracing the whole chunk loop + admission + consensus into one
+    jit collapses that to a single dispatch."""
+    B, Lp = codes.shape
+    n = m + W
+    pad = n
+    Lpile = Lp + 2 * n
+    pileup = jnp.zeros((B, Lpile, PACK_LANES), jnp.float32)
+
+    chunks = []
+    for c in range(n_chunks):
+        sl = slice(c * CH, (c + 1) * CH)
+        res, q, qq, win_start, passed, pos0, span, ign = _gather_and_align(
+            map_flat, q_codes, rc_codes, q_qual, q_lengths,
+            sread[sl], strand[sl].astype(jnp.int32), lread[sl], diag[sl],
+            Lp, m=m, W=W, ap=ap, ignore_flat=ignore_flat,
+            interpret=interpret)
+        live = jnp.arange(sl.start, sl.start + CH) < n_cand
+        chunks.append((res, q, qq, win_start, passed & live, pos0, span,
+                       ign))
+
+    all_passed = jnp.concatenate([c[4] for c in chunks])
+    all_pos0 = jnp.concatenate([c[5] for c in chunks])
+    all_span = jnp.concatenate([c[6] for c in chunks])
+    all_score = jnp.concatenate([c[0].score for c in chunks])
+    R_tot = all_passed.shape[0]
+    admitted = device_admit(
+        lread[:R_tot], all_pos0, all_span, all_score, all_passed,
+        lengths, cns)
+
+    taboo_frac = cns.indel_taboo if cns.trim else 0.0
+    taboo_abs = (cns.indel_taboo_length or 0) if cns.trim else 0
+    for c, (res, q, qq, win_start, passed, pos0, span, ign) in \
+            enumerate(chunks):
+        sl = slice(c * CH, (c + 1) * CH)
+        keep = admitted[sl]
+        w0p = jnp.clip(win_start + pad, 0, Lpile - n)
+        if cns.qual_weighted:
+            votes = build_votes(
+                res.state, res.qrow, res.ins_len, q, qq,
+                res.q_start, res.q_end, keep,
+                ignore_cols=ign, qual_weighted=True,
+                taboo_frac=taboo_frac, taboo_abs=taboo_abs,
+                min_aln_length=cns.min_aln_length)
+            pileup = pileup_accumulate(
+                pileup, votes, lread[sl], w0p, interpret=interpret)
+        else:
+            words = encode_votes(
+                res.state, res.qrow, res.ins_len, q,
+                res.q_start, res.q_end, ignore_cols=ign,
+                taboo_frac=taboo_frac, taboo_abs=taboo_abs,
+                min_aln_length=cns.min_aln_length)
+            words = jnp.where(keep[:, None], words, 0)
+            pileup = pileup_accumulate_packed(
+                pileup, words, lread[sl], w0p, interpret=interpret)
+
+    pile = unpack_pileup(pileup, pad, Lp)
+    if cns.use_ref_qual:
+        pos = jnp.arange(Lp, dtype=jnp.int32)[None, :]
+        lmask = (pos < lengths[:, None]).astype(jnp.float32)
+        pile = add_ref_votes(pile, codes, qual.astype(jnp.float32), lmask)
+
+    call = call_consensus(pile, codes, cns.max_ins_length)
+    n_admitted = admitted.sum()
+    if not collect:
+        return call, n_admitted, None, None
+    scalars = (
+        lread[:R_tot], all_pos0, all_span, admitted,
+        jnp.concatenate([c[0].q_start for c in chunks]),
+        jnp.concatenate([c[0].q_end for c in chunks]),
+        jnp.concatenate([c[3] for c in chunks]),
+        jnp.concatenate([c[0].r_start for c in chunks]),
+        jnp.concatenate([c[0].r_end for c in chunks]),
+    )
+    slabs = ([c[0].state for c in chunks],
+             [c[0].qrow for c in chunks],
+             [c[0].ins_len for c in chunks])
+    return call, n_admitted, scalars, slabs
+
+
+_fused_pass = functools.partial(
+    jax.jit,
+    static_argnames=("m", "W", "CH", "n_chunks", "ap", "cns", "interpret",
+                     "collect"),
+)(_fused_pass_body)
+
+
 class DeviceCorrector:
     """Chunked device correction over one long-read batch state."""
 
@@ -412,6 +520,8 @@ class DeviceCorrector:
         seed_stride: int = 8, seed_min_votes: int = 2,
         collect_aln: bool = False,
     ):
+        import time as _time
+        _t0 = _time.time()
         B, Lp = codes.shape
         m = q_codes.shape[1]
         W = bsw.band_lanes(ap)
@@ -430,7 +540,9 @@ class DeviceCorrector:
             n_valid.copy_to_host_async()
         except AttributeError:
             pass
+        _t1 = _time.time()
         n_cand = int(n_valid)                       # host sync #1
+        _t2 = _time.time()
 
         map_flat = map_codes.reshape(-1)
         ignore_flat = None
@@ -438,7 +550,14 @@ class DeviceCorrector:
             ignore_flat = mask_cols.reshape(-1)
 
         CH = self.chunk
-        n_chunks = max(1, -(-n_cand // CH))
+        # bucket the chunk count to a power of two: n_chunks is a static
+        # arg of the fused program, so each distinct value is a separate
+        # XLA compile — pow2 bucketing bounds the variants to O(log R) at
+        # the cost of masked dead rows in the rounded-up chunks
+        need = max(1, -(-n_cand // CH))
+        n_chunks = 1
+        while n_chunks < need:
+            n_chunks *= 2
         # every chunk slice must have exactly CH rows (bsw_expand asserts
         # R % block == 0); pad the candidate arrays when the slot count is
         # not a chunk multiple. Pad lreads repeat the last row so read_of
@@ -454,79 +573,26 @@ class DeviceCorrector:
             lread = jnp.concatenate(
                 [lread, jnp.broadcast_to(lread[-1], (padn,))])
             diag = jnp.concatenate([diag, jnp.zeros(padn, diag.dtype)])
-        pad = n
-        Lpile = Lp + 2 * n
-        pileup = jnp.zeros((B, Lpile, PACK_LANES), jnp.float32)
 
-        chunks = []
-        for c in range(n_chunks):
-            sl = slice(c * CH, (c + 1) * CH)
-            res, q, qq, win_start, passed, pos0, span, ign = \
-                _gather_and_align(
-                    map_flat, q_codes, rc_codes, q_qual, q_lengths,
-                    sread[sl], strand[sl].astype(jnp.int32), lread[sl],
-                    diag[sl], Lp, m=m, W=W, ap=ap,
-                    ignore_flat=ignore_flat, interpret=self.interpret)
-            live = (jnp.arange(sl.start, sl.start + CH) < n_cand)
-            chunks.append((res, q, qq, win_start, passed & live, pos0, span,
-                           ign, sl))
-
-        all_passed = jnp.concatenate([c[4] for c in chunks])
-        all_pos0 = jnp.concatenate([c[5] for c in chunks])
-        all_span = jnp.concatenate([c[6] for c in chunks])
-        all_score = jnp.concatenate([c[0].score for c in chunks])
-        R_tot = all_passed.shape[0]
-        admitted = device_admit(
-            lread[:R_tot], all_pos0, all_span, all_score, all_passed,
-            lengths, cns)
-
-        taboo_frac = cns.indel_taboo if cns.trim else 0.0
-        taboo_abs = (cns.indel_taboo_length or 0) if cns.trim else 0
-        for (res, q, qq, win_start, passed, pos0, span, ign, sl) in chunks:
-            keep = admitted[sl.start:sl.start + CH]
-            w0p = jnp.clip(win_start + pad, 0, Lpile - n)
-            if cns.qual_weighted:
-                votes = build_votes(
-                    res.state, res.qrow, res.ins_len, q, qq,
-                    res.q_start, res.q_end, keep,
-                    ignore_cols=ign, qual_weighted=True,
-                    taboo_frac=taboo_frac, taboo_abs=taboo_abs,
-                    min_aln_length=cns.min_aln_length)
-                pileup = pileup_accumulate(
-                    pileup, votes, lread[sl], w0p, interpret=self.interpret)
-            else:
-                # packed fast path: one i32 per column, decoded in-kernel
-                words = encode_votes(
-                    res.state, res.qrow, res.ins_len, q,
-                    res.q_start, res.q_end, ignore_cols=ign,
-                    taboo_frac=taboo_frac, taboo_abs=taboo_abs,
-                    min_aln_length=cns.min_aln_length)
-                words = jnp.where(keep[:, None], words, 0)
-                pileup = pileup_accumulate_packed(
-                    pileup, words, lread[sl], w0p, interpret=self.interpret)
-
-        pile = unpack_pileup(pileup, pad, Lp)
-        if cns.use_ref_qual:
-            pos = jnp.arange(Lp, dtype=jnp.int32)[None, :]
-            lmask = (pos < lengths[:, None]).astype(jnp.float32)
-            pile = add_ref_votes(pile, codes, qual.astype(jnp.float32), lmask)
-
-        call = call_consensus(pile, codes, cns.max_ins_length)
-        stats = DevicePassStats(n_candidates=n_cand,
-                                n_admitted=admitted.sum())
+        call, n_admitted, scalars, slabs = _fused_pass(
+            map_flat, ignore_flat, codes, qual, lengths,
+            q_codes, rc_codes, q_qual, q_lengths,
+            sread[:R_need], strand[:R_need], lread[:R_need], diag[:R_need],
+            jnp.asarray(n_cand, jnp.int32),
+            m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
+            interpret=self.interpret, collect=collect_aln)
+        log.debug("correct_pass: seed-enqueue %.0f ms, n_cand sync %.0f ms, "
+                  "fused-enqueue %.0f ms (n_cand=%d, chunks=%d)",
+                  (_t1 - _t0) * 1e3, (_t2 - _t1) * 1e3,
+                  (_time.time() - _t2) * 1e3, n_cand, n_chunks)
+        stats = DevicePassStats(n_candidates=n_cand, n_admitted=n_admitted)
         if not collect_aln:
             return call, stats
 
         # one host fetch of the per-candidate scalars for the chimera scan
-        h = jax.device_get((
-            lread[:R_tot], all_pos0, all_span, admitted,
-            jnp.concatenate([c[0].q_start for c in chunks]),
-            jnp.concatenate([c[0].q_end for c in chunks]),
-            jnp.concatenate([c[3] for c in chunks]),
-            jnp.concatenate([c[0].r_start for c in chunks]),
-            jnp.concatenate([c[0].r_end for c in chunks]),
-        ))
+        h = jax.device_get(scalars)
         (h_lread, h_pos0, h_span, h_adm, h_qs, h_qe, h_ws, h_rs, h_re) = h
+        R_tot = R_need
         aln_len = h_qe - h_qs
         if cns.indel_taboo_length:
             taboo = np.full(R_tot, cns.indel_taboo_length, np.int32)
@@ -536,10 +602,11 @@ class DeviceCorrector:
         vote_ok = ((aln_len > cns.min_aln_length)
                    & (kept >= cns.min_aln_length)
                    & (kept >= 0.7 * aln_len))
+        st_l, qr_l, il_l = slabs
         aln = AlnData(
             lread=h_lread, pos0=h_pos0, span=h_span, admitted=h_adm,
             vote_ok=vote_ok, q_start=h_qs, q_end=h_qe, win_start=h_ws,
             r_start=h_rs, r_end=h_re, cns=cns,
-            chunks=[(c[0].state, c[0].qrow, c[0].ins_len) for c in chunks],
+            chunks=list(zip(st_l, qr_l, il_l)),
             chunk_size=CH)
         return call, stats, aln

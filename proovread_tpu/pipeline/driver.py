@@ -276,12 +276,17 @@ class Pipeline:
         log.debug("finish correct_pass: %.0f ms", (_time.time() - _t0) * 1e3)
 
         # the single corrected-read fetch + host assembly (trim needs the
-        # consensus cigar and per-base freqs)
+        # consensus cigar and per-base freqs). Dtypes are compacted on
+        # device first — the tunneled link is bandwidth-bound, and freqs/
+        # coverage are small integers-with-halves (quality-weight sums), so
+        # float16 is lossless at the magnitudes involved (< 2048).
         _t0 = _time.time()
         em, base, ins_len, ins_bases, freq, phred, cov, lens_h = \
-            jax.device_get((call.emitted, call.base, call.ins_len,
-                            call.ins_bases, call.freq, call.phred,
-                            call.coverage, lengths))
+            jax.device_get((call.emitted, call.base,
+                            call.ins_len.astype(jnp.int16),
+                            call.ins_bases, call.freq.astype(jnp.float16),
+                            call.phred.astype(jnp.uint8),
+                            call.coverage.astype(jnp.float16), lengths))
         log.debug("finish fetch: %.0f ms", (_time.time() - _t0) * 1e3)
         _t0 = _time.time()
         out = []

@@ -1,0 +1,197 @@
+"""Config-driven task orchestration — the role of ``bin/proovread``'s task
+state machine (``:705-900``) above the device pipeline.
+
+``run_tasks`` executes a mode's task list from :class:`~proovread_tpu.config.
+Config`: the optional ``ccs-1`` subread pre-consensus (``:871-895``), the
+optional ``utg`` unitig pass, the iterated ``bwa-{sr,mr}-N`` + finish passes
+(delegated to :class:`Pipeline`), the external-mapping re-entry modes
+(``read-sam``/``read-bam`` -> :func:`sam2cns`, ``:718-736``), and the final
+trim + siamaera output stage (``:904-956``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Sequence
+
+from proovread_tpu.config import Config
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.pipeline.driver import (Pipeline, PipelineConfig,
+                                           PipelineResult, TaskReport)
+from proovread_tpu.pipeline.masking import MaskParams
+from proovread_tpu.pipeline.trim import TrimParams, trim_records
+
+log = logging.getLogger("proovread_tpu")
+
+
+def _trim_params(cfg: Config) -> TrimParams:
+    sf = cfg.get("seq-filter") or {}
+    ch = cfg.get("chimera-filter") or {}
+    win = str(sf.get("--trim-win", "12,5")).split(",")
+    return TrimParams(
+        win_mean_min=float(win[0]), win_abs_min=float(win[1]),
+        min_length=int(sf.get("--min-length", 500)),
+        chim_min_score=float(ch.get("--min-score", 0.2)),
+        chim_trim_len=int(ch.get("--trim-length", 20)),
+    )
+
+
+def _pipeline_config(cfg: Config, mode: str, tasks: Sequence[str],
+                     coverage, lr_min_length, sampling) -> PipelineConfig:
+    base = "mr" if mode.startswith("mr") else "sr"
+    n_iter = sum(1 for t in tasks
+                 if t.startswith(f"bwa-{base}-") and not t.endswith("finish"))
+    it_task = f"bwa-{base}-1"
+    fin_task = f"bwa-{base}-finish"
+    late_task = f"bwa-{base}-5"
+    return PipelineConfig(
+        mode=base,
+        n_iterations=max(n_iter, 1),
+        sr_coverage=float(cfg.get("sr-coverage", it_task)),
+        finish_coverage=float(cfg.get("sr-coverage", fin_task)),
+        coverage=coverage,
+        mask_shortcut_frac=float(cfg.get("mask-shortcut-frac")),
+        mask_min_gain_frac=float(cfg.get("mask-min-gain-frac")),
+        hcr_mask=MaskParams.from_cfg_string(cfg.get("hcr-mask", it_task)),
+        hcr_mask_late=MaskParams.from_cfg_string(
+            cfg.get("hcr-mask", late_task)),
+        lr_min_length=lr_min_length,
+        sampling=sampling,
+        trim=_trim_params(cfg),
+        indel_taboo_length=int(cfg.get("sr-indel-taboo-length")),
+        coverage_scale=float(cfg.get("coverage-scale-factor")),
+        engine=str(cfg.get("engine")),
+        batch_reads=int(cfg.get("batch-reads")),
+        device_chunk=int(cfg.get("device-chunk")),
+        seed_stride=int(cfg.get("seed-stride")),
+    )
+
+
+def _apply_siamaera(cfg: Config, result: PipelineResult) -> None:
+    """Final-output siamaera pass over the trimmed records
+    (bin/proovread:923-933); ``"siamaera": null`` in the config
+    deactivates it, like the reference's commented-out key."""
+    if cfg.data.get("siamaera", {}) is None:
+        return
+    from proovread_tpu.pipeline.siamaera import siamaera_filter
+    t0 = time.time()
+    trimmed, stats = siamaera_filter(result.trimmed)
+    result.trimmed = trimmed
+    log.info("siamaera: %d checked, %d trimmed, %d dropped (%.1fs)",
+             stats.checked, stats.trimmed, stats.dropped, time.time() - t0)
+
+
+def run_tasks(
+    cfg: Config,
+    mode: str,
+    tasks: Sequence[str],
+    longs: List[SeqRecord],
+    shorts: List[SeqRecord],
+    utgs: Optional[List[SeqRecord]] = None,
+    sam: Optional[str] = None,
+    bam: Optional[str] = None,
+    coverage: Optional[float] = None,
+    lr_min_length: Optional[int] = None,
+    sampling: bool = True,
+) -> PipelineResult:
+    reports: List[TaskReport] = []
+
+    # -- read-long: input normalization for every mode
+    # (bin/proovread:1368-1520; min_sr fallback 200 for utg-only modes,
+    # bin/proovread:658) --------------------------------------------------
+    sr_lens = sorted(len(r) for r in shorts)
+    min_sr = sr_lens[len(sr_lens) // 2] if sr_lens else 200
+    rl_pipe = Pipeline(PipelineConfig(lr_min_length=lr_min_length))
+    longs, ignored0 = rl_pipe.read_long(longs, min_sr)
+
+    # -- ccs-1: subread circular pre-consensus (bin/proovread:871-895) ----
+    if "ccs-1" in tasks:
+        from proovread_tpu.pipeline.ccs import ccs_correct, is_subread_set
+        if not is_subread_set(longs):
+            log.info("ccs-1: ids are not PacBio subreads, skipping "
+                     "(-noccs fallback, bin/proovread:1512-1517)")
+        else:
+            t0 = time.time()
+            longs, st = ccs_correct(longs)
+            reports.append(TaskReport("ccs-1", 0.0, 0, st.primary))
+            log.info("ccs-1: %d primary, %d single, %d secondary dropped "
+                     "(%.1fs)", st.primary, st.single, st.secondary,
+                     time.time() - t0)
+
+    # -- external-mapping re-entry (read-sam/read-bam) --------------------
+    if "read-sam" in tasks or "read-bam" in tasks:
+        from proovread_tpu.consensus.params import ConsensusParams
+        from proovread_tpu.pipeline.sam2cns import Sam2CnsConfig, sam2cns
+        task = "read-sam" if "read-sam" in tasks else "read-bam"
+        src = sam if sam is not None else bam
+        if src is None:
+            raise ValueError(f"mode {mode!r} needs --sam/--bam input")
+        params = ConsensusParams(
+            indel_taboo_length=int(cfg.get("sr-indel-taboo-length")),
+            use_ref_qual=True,
+            bin_size=int(cfg.get("bin-size", task)),
+            max_coverage=int(cfg.get("max-coverage", task)),
+            rep_coverage=int(cfg.get("rep-coverage", task) or 0),
+        )
+        s2c = Sam2CnsConfig(
+            params=params,
+            detect_chimera=bool(cfg.get("detect-chimera", task)),
+            max_ref_seqs=int(cfg.get("chunk-size")),
+        )
+        t0 = time.time()
+        results = list(sam2cns(src, longs, s2c))
+        log.info("%s: %d reads corrected (%.1fs)", task, len(results),
+                 time.time() - t0)
+        chim = [(r.record.id, f, t, s)
+                for r in results for (f, t, s) in r.chimera]
+        result = PipelineResult(
+            untrimmed=[r.record for r in results],
+            trimmed=trim_records(results, _trim_params(cfg)),
+            ignored=ignored0, chimera=chim, reports=reports)
+        _apply_siamaera(cfg, result)
+        return result
+
+    # -- utg pass ---------------------------------------------------------
+    utg_corrected = None
+    if any(t in ("utg",) or t.endswith("-utg") for t in tasks):
+        if not utgs:
+            raise ValueError(f"mode {mode!r} needs -u/--unitigs input")
+        from proovread_tpu.pipeline.utg import utg_correct
+        t0 = time.time()
+        longs, utg_rep = utg_correct(cfg, longs, utgs)
+        reports.append(utg_rep)
+        log.info("utg: masked %.1f%% (%.1fs)", utg_rep.masked_frac * 100,
+                 time.time() - t0)
+        utg_corrected = True
+
+    # -- iterated short-read correction ----------------------------------
+    base = "mr" if mode.startswith("mr") else "sr"
+    has_iter = any(t.startswith(f"bwa-{base}-") for t in tasks)
+    if has_iter:
+        if not shorts:
+            raise ValueError(f"mode {mode!r} needs -s/--short-reads input")
+        pc = _pipeline_config(cfg, mode, tasks, coverage, lr_min_length,
+                              sampling)
+        pipe = Pipeline(pc)
+        result = pipe.run(longs, shorts)
+        result.reports = reports + result.reports
+        result.ignored = ignored0 + result.ignored
+        _apply_siamaera(cfg, result)
+        return result
+
+    if utg_corrected:
+        # utg-only mode: corrected reads come straight from the utg pass;
+        # trimmed output gets the same quality-window + min-length trim as
+        # every other mode (bin/proovread:923-933)
+        from proovread_tpu.pipeline.trim import trim_window
+        trim = _trim_params(cfg)
+        trimmed = [t for r in longs
+                   if (t := trim_window(r, trim)) is not None]
+        result = PipelineResult(
+            untrimmed=longs, trimmed=trimmed,
+            ignored=ignored0, chimera=[], reports=reports)
+        _apply_siamaera(cfg, result)
+        return result
+
+    raise ValueError(f"mode {mode!r}: no runnable tasks in {tasks}")

@@ -1,0 +1,188 @@
+"""Config system + CLI driver tests.
+
+Parity targets: ``proovread.cfg`` mode-tasks + task-scoped ``cfg()``
+resolution (``bin/proovread:1989-2024``), mode auto-detection
+(``bin/proovread:625-654``), the output layout (``:904-956``), and the
+``--create-cfg`` template (``:1779-1799``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from proovread_tpu.config import Config, mode_auto
+from proovread_tpu.io import fastq
+from proovread_tpu.io.records import SeqRecord
+
+
+class TestConfig:
+    def test_plain_key(self):
+        cfg = Config()
+        assert cfg.get("mask-shortcut-frac") == 0.92
+        assert cfg.get("unknown-key", default="d") == "d"
+
+    def test_task_scoped_resolution(self):
+        cfg = Config()
+        assert cfg.get("sr-coverage") == 15
+        assert cfg.get("sr-coverage", "bwa-sr-3") == 15       # DEF fallback
+        assert cfg.get("sr-coverage", "bwa-sr-finish") == 30  # exact
+        # counter stripping: bwa-sr-4 has an exact hcr-mask override
+        assert cfg.get("hcr-mask", "bwa-sr-4").endswith("0.3")
+        assert cfg.get("hcr-mask", "bwa-sr-2").endswith("0.7")
+
+    def test_key_counter_stripping(self):
+        cfg = Config()
+        # key itself carries a counter: sr-coverage-3 -> sr-coverage
+        assert cfg.get("sr-coverage-3") == 15
+
+    def test_layering(self, tmp_path):
+        p = tmp_path / "user.cfg"
+        p.write_text('// comment\n{"sr-coverage": {"DEF": 99},\n'
+                     '"mask-shortcut-frac": 0.5}\n')
+        cfg = Config.load(str(p))
+        assert cfg.get("sr-coverage") == 99
+        assert cfg.get("sr-coverage", "bwa-sr-finish") == 30  # merged
+        assert cfg.get("mask-shortcut-frac") == 0.5
+
+    def test_tasks_lists(self):
+        cfg = Config()
+        assert cfg.tasks("sr")[0] == "read-long"
+        assert cfg.tasks("sr")[-1] == "bwa-sr-finish"
+        assert "ccs-1" not in cfg.tasks("sr-noccs")
+        assert "utg" in cfg.tasks("mr+utg")
+        with pytest.raises(ValueError):
+            cfg.tasks("bogus")
+
+    def test_template_round_trip(self, tmp_path):
+        p = str(tmp_path / "template.cfg")
+        Config.create_template(p)
+        cfg = Config.load(p)    # fully commented: pure defaults
+        assert cfg.get("sr-coverage") == 15
+
+    def test_template_single_line_uncomment(self, tmp_path):
+        """Uncommenting one mid-file scalar line (the documented edit flow)
+        must yield a loadable config despite the trailing comma."""
+        p = str(tmp_path / "template.cfg")
+        Config.create_template(p)
+        lines = open(p).read().split("\n")
+        for i, ln in enumerate(lines):
+            if '"sr-chunk-number"' in ln:
+                lines[i] = ln[2:].replace("1000", "777")
+                break
+        open(p, "w").write("\n".join(lines))
+        cfg = Config.load(p)
+        assert cfg.get("sr-chunk-number") == 777
+        assert cfg.get("sr-coverage") == 15
+
+
+class TestModeAuto:
+    def test_auto(self):
+        assert mode_auto(100, False, True) == "sr"
+        assert mode_auto(250, False, True) == "mr"
+        assert mode_auto(100, True, True) == "sr+utg"
+        assert mode_auto(100, False, False) == "sr-noccs"
+        assert mode_auto(None, True, False) == "utg-noccs"
+        assert mode_auto(100, False, True, bam=True) == "bam"
+
+
+def _mk_inputs(tmp_path, n_longs=4, n_srs=400):
+    rng = np.random.default_rng(3)
+    bases = "ACGT"
+    genome = "".join(bases[i] for i in rng.integers(0, 4, 3000))
+    longs = []
+    for i in range(n_longs):
+        st = int(rng.integers(0, len(genome) - 900))
+        seq = list(genome[st:st + 900])
+        for mu in np.flatnonzero(rng.random(900) < 0.08):
+            seq[mu] = bases[int(rng.integers(0, 4))]
+        longs.append(SeqRecord(f"lr{i}", "".join(seq),
+                               qual=np.full(900, 5, np.uint8)))
+    srs = []
+    for i in range(n_srs):
+        st = int(rng.integers(0, len(genome) - 100))
+        srs.append(SeqRecord(f"s{i}", genome[st:st + 100],
+                             qual=np.full(100, 30, np.uint8)))
+    lp = tmp_path / "long.fq"
+    sp = tmp_path / "short.fq"
+    with open(lp, "wb") as fh:
+        w = fastq.FastqWriter(fh)
+        for r in longs:
+            w.write(r)
+    with open(sp, "wb") as fh:
+        w = fastq.FastqWriter(fh)
+        for r in srs:
+            w.write(r)
+    return str(lp), str(sp)
+
+
+class TestCli:
+    def test_create_cfg(self, tmp_path):
+        from proovread_tpu.cli import main
+        p = str(tmp_path / "t.cfg")
+        assert main(["--create-cfg", p]) == 0
+        assert os.path.exists(p)
+
+    def test_missing_args(self):
+        from proovread_tpu.cli import main
+        assert main(["-l", "x.fq"]) == 2
+
+    def test_end_to_end_sr(self, tmp_path):
+        from proovread_tpu.cli import main
+        lp, sp = _mk_inputs(tmp_path)
+        out = str(tmp_path / "res")
+        rc = main(["-l", lp, "-s", sp, "-p", out, "-m", "sr-noccs",
+                   "--quiet"])
+        assert rc == 0
+        names = os.listdir(out)
+        assert "res.untrimmed.fq" in names
+        assert "res.trimmed.fq" in names
+        assert "res.trimmed.fa" in names
+        assert "res.ignored.tsv" in names
+        assert "res.chim.tsv" in names
+        assert "res.parameter.log" in names
+        cor = list(fastq.FastqReader(os.path.join(out, "res.untrimmed.fq")))
+        assert len(cor) == 4
+        params = json.loads(
+            open(os.path.join(out, "res.parameter.log")).read())
+        assert params["mode"] == "sr-noccs"
+        assert params["tasks"][0] == "read-long"
+
+    def test_refuses_nonempty_outdir(self, tmp_path):
+        from proovread_tpu.cli import main
+        lp, sp = _mk_inputs(tmp_path, n_longs=1, n_srs=10)
+        out = str(tmp_path / "res2")
+        os.makedirs(out)
+        open(os.path.join(out, "existing"), "w").write("x")
+        assert main(["-l", lp, "-s", sp, "-p", out]) == 2
+
+    def test_sam_reentry_mode(self, tmp_path):
+        """--sam re-entry: external mapping -> consensus -> outputs
+        (read-sam task, bin/proovread:718-736)."""
+        from proovread_tpu.cli import main
+        rng = np.random.default_rng(5)
+        bases = "ACGT"
+        true = "".join(bases[i] for i in rng.integers(0, 4, 800))
+        ref = true[:300] + "T" + true[301:]
+        lp = tmp_path / "long.fq"
+        with open(lp, "wb") as fh:
+            fastq.FastqWriter(fh).write(
+                SeqRecord("lr0", ref, qual=np.full(800, 5, np.uint8)))
+        sam = tmp_path / "map.sam"
+        with open(sam, "w") as fh:
+            fh.write(f"@SQ\tSN:lr0\tLN:{len(ref)}\n")
+            for i in range(8):
+                st = 260 + i * 10
+                fh.write("\t".join([
+                    f"s{i}", "0", "lr0", str(st + 1), "60", "80M", "*",
+                    "0", "0", true[st:st + 80], "I" * 80,
+                    "AS:i:400"]) + "\n")
+        out = str(tmp_path / "res3")
+        rc = main(["-l", str(lp), "--sam", str(sam), "-p", out, "--quiet"])
+        assert rc == 0
+        cor = list(fastq.FastqReader(os.path.join(out, "res3.untrimmed.fq")))
+        assert len(cor) == 1
+        assert cor[0].seq[300].upper() == true[300]

@@ -1,0 +1,94 @@
+"""Multi-chip sharding: the sharded iteration step must produce the same
+corrected reads as the single-device fused pass (SURVEY §2.3 row 1 — the
+reference's job-level data parallelism has no cross-chunk coupling, so
+sharding over reads is exact, not approximate)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from proovread_tpu.align.params import BWA_SR
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.batch import pack_reads
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.parallel.dmesh import make_dp_mesh, sharded_iteration_step
+from proovread_tpu.pipeline.dcorrect import (DeviceCorrector,
+                                             device_assemble,
+                                             device_hcr_mask,
+                                             device_revcomp)
+from proovread_tpu.pipeline.masking import MaskParams
+
+BASES = "ACGT"
+Lp, M = 512, 128
+
+
+def _data(n_devices, seed=0):
+    """Each long read gets its OWN genome segment, so no query's seed-slot
+    budget saturates: per-shard and global seeding then select identical
+    candidate sets and the comparison is exact (with a shared genome,
+    per-shard top-S cluster selection is legitimately MORE sensitive than
+    global — a documented deviation, not an error)."""
+    rng = np.random.default_rng(seed)
+    B = 2 * n_devices
+    longs, srs = [], []
+    si = 0
+    for i in range(B):
+        genome = "".join(BASES[k] for k in rng.integers(0, 4, 400))
+        seq = list(genome)
+        for mu in np.flatnonzero(rng.random(400) < 0.03):
+            seq[mu] = BASES[int(rng.integers(0, 4))]
+        longs.append(SeqRecord(f"lr{i}", "".join(seq),
+                               qual=np.full(400, 5, np.uint8)))
+        for p in rng.integers(0, 300, 16):
+            srs.append(SeqRecord(f"s{si}", genome[p:p + 100],
+                                 qual=np.full(100, 30, np.uint8)))
+            si += 1
+    lr = pack_reads(longs, pad_len=Lp)
+    sr = pack_reads(srs, pad_len=M)
+    return lr, sr
+
+
+@pytest.mark.skipif(jax.device_count() < 4, reason="needs >=4 devices")
+class TestShardedStep:
+    def test_sharded_matches_single_device(self):
+        n_dev = 4
+        lr, sr = _data(n_dev)
+        ap = BWA_SR
+        cns = ConsensusParams(use_ref_qual=True, indel_taboo_length=7)
+        mp = MaskParams().scaled(100)
+
+        codes = jnp.asarray(lr.codes)
+        qual = jnp.asarray(lr.qual)
+        lengths = jnp.asarray(lr.lengths)
+        mask0 = jnp.zeros_like(codes, dtype=bool)
+        qc = jnp.asarray(sr.codes)
+        qq = jnp.asarray(sr.qual)
+        qlen = jnp.asarray(sr.lengths)
+        rcq = device_revcomp(qc, qlen)
+
+        # single-device reference result (chunk small so the per-shard cap
+        # cannot differ)
+        dc = DeviceCorrector(chunk=1024)
+        call, stats = dc.correct_pass(
+            codes, qual, lengths, None, qc, rcq, qq, qlen, ap, cns)
+        c1, q1, l1 = device_assemble(call, qual, lengths, Lp)
+        m1, frac1 = device_hcr_mask(q1, l1, mp)
+
+        mesh = make_dp_mesh(n_dev)
+        step = sharded_iteration_step(
+            mesh, ap, cns, mp, Lp=Lp, m=M,
+            chunks_per_shard=1, chunk=1024)
+        c2, q2, l2, m2, frac2, n_adm = step(
+            codes, qual, lengths, mask0, qc, rcq, qq, qlen)
+
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+        np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+        np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+        assert float(frac2) == pytest.approx(float(frac1), abs=1e-6)
+        assert int(n_adm) == int(np.asarray(stats.n_admitted))
+
+    def test_dryrun_entry(self):
+        import __graft_entry__ as ge
+        ge.dryrun_multichip(4)

@@ -1,0 +1,95 @@
+"""Unitig-assisted correction (the blasr-utg task role,
+``bin/proovread:789-833``) through the task runner."""
+
+import numpy as np
+import pytest
+
+from proovread_tpu.config import Config
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.pipeline.tasks import run_tasks
+from proovread_tpu.pipeline.utg import utg_correct
+
+BASES = "ACGT"
+
+
+def _identity(a: str, b: str) -> float:
+    import difflib
+    sm = difflib.SequenceMatcher(None, a.upper(), b.upper(), autojunk=False)
+    return sum(m.size for m in sm.get_matching_blocks()) / max(
+        len(a), len(b), 1)
+
+
+def _mk(rng, glen=2400, n_longs=3, err=0.10):
+    genome = "".join(BASES[i] for i in rng.integers(0, 4, glen))
+    longs = []
+    for i in range(n_longs):
+        st = int(rng.integers(0, glen - 1000))
+        seq = []
+        for c in genome[st:st + 1000]:
+            u = rng.random()
+            if u < err * 0.3:
+                continue                              # deletion
+            if u < err * 0.5:
+                seq.append(BASES[int(rng.integers(0, 4))])  # insertion
+            if u < err:
+                seq.append(BASES[int(rng.integers(0, 4))])  # substitution
+            else:
+                seq.append(c)
+        longs.append(SeqRecord(f"lr{i}", "".join(seq),
+                               qual=np.full(len(seq), 5, np.uint8),
+                               desc=f"src:{st}"))
+    # unitigs: exact genome fragments covering everything
+    utgs = [SeqRecord(f"utg{k}", genome[k * 700: k * 700 + 1000])
+            for k in range((glen - 300) // 700)]
+    return genome, longs, utgs
+
+
+@pytest.fixture(scope="module")
+def small_cfg():
+    cfg = Config()
+    cfg.update({"utg-window": 256, "utg-overlap": 32})
+    return cfg
+
+
+class TestUtgCorrect:
+    def test_identity_improves(self, small_cfg):
+        rng = np.random.default_rng(11)
+        genome, longs, utgs = _mk(rng)
+        out, rep = utg_correct(small_cfg, longs, utgs)
+        assert rep.task == "utg"
+        assert rep.n_candidates > 0
+        assert len(out) == len(longs)
+        for rec_in, rec_out in zip(longs, out):
+            st = int(rec_in.desc.split(":")[1])
+            true = genome[st:st + 1000]
+            before = _identity(rec_in.seq, true)
+            after = _identity(rec_out.seq, true)
+            assert after > before + 0.03, (before, after)
+            assert after > 0.95
+
+    def test_quals_encode_support(self, small_cfg):
+        rng = np.random.default_rng(12)
+        _, longs, utgs = _mk(rng, n_longs=1)
+        out, rep = utg_correct(small_cfg, longs, utgs)
+        q = out[0].qual
+        assert q is not None
+        assert (q >= 20).mean() > 0.5    # most columns unitig-supported
+        assert rep.masked_frac == pytest.approx((q >= 20).mean(), abs=0.02)
+
+
+class TestUtgTaskRunner:
+    def test_utg_only_mode(self, small_cfg):
+        rng = np.random.default_rng(13)
+        genome, longs, utgs = _mk(rng, n_longs=2)
+        res = run_tasks(small_cfg, "utg-noccs",
+                        small_cfg.tasks("utg-noccs"), longs, [], utgs)
+        assert len(res.untrimmed) == 2
+        assert [r.task for r in res.reports] == ["utg"]
+        # utg-only output: trimmed applies only min-length
+        assert all(len(r) >= 500 for r in res.trimmed)
+
+    def test_utg_requires_unitigs(self, small_cfg):
+        with pytest.raises(ValueError, match="unitigs"):
+            run_tasks(small_cfg, "utg-noccs",
+                      small_cfg.tasks("utg-noccs"),
+                      [SeqRecord("x", "ACGT" * 100)], [], [])
