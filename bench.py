@@ -99,9 +99,14 @@ def main():
         return pipe.run(longs, srs)
 
     run_once()                      # warm the compile cache
-    t0 = time.time()
-    res = run_once()
-    dt = time.time() - t0
+    # median of 3 timed runs: the tunneled device shows ±0.5s scheduler
+    # jitter between identical runs; the median is the steady-state number
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        res = run_once()
+        times.append(time.time() - t0)
+    dt = float(np.median(times))
     bases_per_sec = total_bases / dt
 
     origs = {r.id.split("_")[2]: encode_ascii(r.seq)

@@ -234,7 +234,11 @@ def _bsw_kernel(qlen_ref, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
 
 
 def _block_candidates(m: int) -> int:
-    """Candidates per kernel program, sized so dirs fits VMEM."""
+    """Candidates per kernel program, sized so dirs fits VMEM.
+
+    NB: C=256 was tried to amortize the DP loop's per-step op overhead;
+    Mosaic then fails to prove dynamic-slice alignment for the [W, C]
+    window loads ("index in dimension 0 is a multiple of 8")."""
     return 128 if m <= 256 else 64
 
 

@@ -166,6 +166,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     _w(f"{name}.untrimmed.fq", result.untrimmed)
     _w(f"{name}.trimmed.fq", result.trimmed)
     _w(f"{name}.trimmed.fa", result.trimmed, fq=False)
+    if args.debug:
+        # per-read consensus debug dump (the role of bam2cns --debug's
+        # trace strings + filtered BAM, bin/bam2cns:271-295)
+        with open(os.path.join(outdir, f"{name}.debug.tsv"), "w") as fh:
+            fh.write("id\tlen\tmean_phred\tmasked_frac\n")
+            for r in result.untrimmed:
+                q = r.qual if r.qual is not None else np.zeros(0)
+                fh.write(f"{r.id}\t{len(r)}\t"
+                         f"{float(q.mean()) if len(q) else 0:.1f}\t"
+                         f"{float((q == 0).mean()) if len(q) else 0:.3f}\n")
     with open(os.path.join(outdir, f"{name}.ignored.tsv"), "w") as fh:
         for rid, why in result.ignored:
             fh.write(f"{rid}\t{why}\n")

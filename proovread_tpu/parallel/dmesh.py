@@ -27,7 +27,7 @@ from proovread_tpu.align import bsw, dseed
 from proovread_tpu.align.params import AlignParams
 from proovread_tpu.consensus.params import ConsensusParams
 from proovread_tpu.ops.encode import N
-from proovread_tpu.pipeline.dcorrect import (_fused_pass_body,
+from proovread_tpu.pipeline.dcorrect import (_fused_pass_body, _pad_candidates,
                                              device_assemble,
                                              device_hcr_mask)
 from proovread_tpu.pipeline.masking import MaskParams
@@ -78,22 +78,14 @@ def sharded_iteration_step(
             stride=seed_stride, min_votes=seed_min_votes)
         sread, strand, lread, diag, n_valid = \
             dseed.compact_candidates(cand)
-        R0 = sread.shape[0]
-        if R_need > R0:
-            padn = R_need - R0
-            sread = jnp.concatenate([sread, jnp.zeros(padn, sread.dtype)])
-            strand = jnp.concatenate([strand,
-                                      jnp.zeros(padn, strand.dtype)])
-            lread = jnp.concatenate(
-                [lread, jnp.broadcast_to(lread[-1], (padn,))])
-            diag = jnp.concatenate([diag, jnp.zeros(padn, diag.dtype)])
-        n_cand = jnp.minimum(n_valid, R_need)
+        sread, strand, lread, diag = _pad_candidates(
+            sread, strand, lread, diag, R_need)
+        n_cand = jnp.minimum(n_valid, R_need).astype(jnp.int32)
 
         call, n_admitted, _, _ = _fused_pass_body(
             map_codes.reshape(-1), mask_cols.reshape(-1),
             codes, qual, lengths, qc, rcq, qq, qlen,
-            sread[:R_need], strand[:R_need], lread[:R_need],
-            diag[:R_need], n_cand,
+            sread, strand, lread, diag, n_cand,
             m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
             interpret=itp, collect=False)
 

@@ -157,15 +157,33 @@ def device_assemble(call: ConsensusCall, ref_qual: jnp.ndarray,
     return new_codes, new_qual, new_len
 
 
-@functools.partial(jax.jit, static_argnames=("p",))
-def device_hcr_mask(qual: jnp.ndarray, lengths: jnp.ndarray, p: MaskParams):
-    """On-device twin of pipeline/masking.py:hcr_intervals/mask_batch.
+def mask_params_vec(p: MaskParams) -> jnp.ndarray:
+    """MaskParams as a length-6 f32 vector for the dynamic mask (iteration
+    loops switch early/late mask params per step, which a static arg can't
+    express inside one traced program)."""
+    return jnp.asarray([p.phred_min, p.phred_max, p.mask_min_len,
+                        p.unmask_min_len, p.mask_reduce, p.end_ratio],
+                       jnp.float32)
+
+
+@jax.jit
+def device_hcr_mask_dyn(qual: jnp.ndarray, lengths: jnp.ndarray,
+                        pv: jnp.ndarray):
+    """On-device twin of pipeline/masking.py:hcr_intervals/mask_batch with
+    the 6 mask params passed as data (``mask_params_vec``).
     Returns (mask bool [B, L], masked_frac scalar)."""
+    phred_min = pv[0].astype(jnp.int32)
+    phred_max = pv[1].astype(jnp.int32)
+    mask_min_len = pv[2].astype(jnp.int32)
+    unmask_min_len = pv[3].astype(jnp.int32)
+    red = pv[4].astype(jnp.int32)
+    end_red = jnp.round(pv[4] * pv[5]).astype(jnp.int32)
+
     B, L = qual.shape
     pos = jnp.arange(L, dtype=jnp.int32)[None, :]
     valid = pos < lengths[:, None]
     q = qual.astype(jnp.int32)
-    inq = (q >= p.phred_min) & (q <= p.phred_max) & valid
+    inq = (q >= phred_min) & (q <= phred_max) & valid
 
     def runs(mask):
         """per-position (start, end) of the containing True run."""
@@ -179,7 +197,7 @@ def device_hcr_mask(qual: jnp.ndarray, lengths: jnp.ndarray, p: MaskParams):
         return start, end
 
     s1, e1 = runs(inq)
-    kept = inq & ((e1 - s1) >= p.mask_min_len)
+    kept = inq & ((e1 - s1) >= mask_min_len)
 
     # merge gaps < unmask_min_len that lie strictly between kept runs
     gap = (~kept) & valid
@@ -194,13 +212,11 @@ def device_hcr_mask(qual: jnp.ndarray, lengths: jnp.ndarray, p: MaskParams):
         has_left, jnp.maximum(gs - 1, 0), axis=1), False)
     right_ok = (ge < lengths[:, None]) & jnp.take_along_axis(
         has_right, jnp.clip(ge, 0, L - 1), axis=1)
-    fill = gap & (gap_len < p.unmask_min_len) & left_in & right_ok
+    fill = gap & (gap_len < unmask_min_len) & left_in & right_ok
     merged = kept | fill
 
     # boundary reduction on merged runs
     ms, me = runs(merged)
-    red = p.mask_reduce
-    end_red = int(round(p.mask_reduce * p.end_ratio))
     lo = ms + jnp.where(ms == 0, end_red, red)
     hi = me - jnp.where(me == lengths[:, None], end_red, red)
     final = merged & (pos >= lo) & (pos < hi)
@@ -208,6 +224,12 @@ def device_hcr_mask(qual: jnp.ndarray, lengths: jnp.ndarray, p: MaskParams):
     total = jnp.maximum(jnp.sum(lengths), 1)
     frac = jnp.sum(final) / total
     return final, frac
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def device_hcr_mask(qual: jnp.ndarray, lengths: jnp.ndarray, p: MaskParams):
+    """Static-params wrapper of :func:`device_hcr_mask_dyn`."""
+    return device_hcr_mask_dyn(qual, lengths, mask_params_vec(p))
 
 
 # --------------------------------------------------------------------------
@@ -423,17 +445,43 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
     Lpile = Lp + 2 * n
     pileup = jnp.zeros((B, Lpile, PACK_LANES), jnp.float32)
 
+    def _dead_chunk():
+        """Same pytree as a live chunk, all-dead: lets callers provision
+        generous static chunk counts (the multi-pass loop can't host-sync
+        a per-pass count) without paying for unused chunks."""
+        zi32 = lambda *s: jnp.zeros(s, jnp.int32)          # noqa: E731
+        res = bsw.BswResult(
+            state=jnp.full((CH, n), -1, jnp.int32), qrow=zi32(CH, n),
+            ins_len=zi32(CH, n), score=jnp.full(CH, -1e9, jnp.float32),
+            q_start=zi32(CH), q_end=zi32(CH), r_start=zi32(CH),
+            r_end=zi32(CH), valid=jnp.zeros(CH, bool))
+        q = jnp.full((CH, m), 4, jnp.int8)
+        qq = jnp.zeros((CH, m), jnp.uint8)
+        ign = (None if ignore_flat is None
+               else jnp.zeros((CH, n), bool))
+        return (res, q, qq, zi32(CH), jnp.zeros(CH, bool), zi32(CH),
+                zi32(CH), ign)
+
     chunks = []
     for c in range(n_chunks):
         sl = slice(c * CH, (c + 1) * CH)
-        res, q, qq, win_start, passed, pos0, span, ign = _gather_and_align(
-            map_flat, q_codes, rc_codes, q_qual, q_lengths,
-            sread[sl], strand[sl].astype(jnp.int32), lread[sl], diag[sl],
-            Lp, m=m, W=W, ap=ap, ignore_flat=ignore_flat,
-            interpret=interpret)
-        live = jnp.arange(sl.start, sl.start + CH) < n_cand
-        chunks.append((res, q, qq, win_start, passed & live, pos0, span,
-                       ign))
+
+        def _live_chunk(sl=sl):
+            res, q, qq, win_start, passed, pos0, span, ign = \
+                _gather_and_align(
+                    map_flat, q_codes, rc_codes, q_qual, q_lengths,
+                    sread[sl], strand[sl].astype(jnp.int32), lread[sl],
+                    diag[sl], Lp, m=m, W=W, ap=ap,
+                    ignore_flat=ignore_flat, interpret=interpret)
+            live = jnp.arange(sl.start, sl.start + CH) < n_cand
+            return (res, q, qq, win_start, passed & live, pos0, span, ign)
+
+        if c == 0:
+            chunks.append(_live_chunk())       # chunk 0 is always live
+        else:
+            chunks.append(jax.lax.cond(
+                jnp.asarray(c * CH, jnp.int32) < n_cand,
+                _live_chunk, _dead_chunk))
 
     all_passed = jnp.concatenate([c[4] for c in chunks])
     all_pos0 = jnp.concatenate([c[5] for c in chunks])
@@ -449,26 +497,35 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
     for c, (res, q, qq, win_start, passed, pos0, span, ign) in \
             enumerate(chunks):
         sl = slice(c * CH, (c + 1) * CH)
-        keep = admitted[sl]
-        w0p = jnp.clip(win_start + pad, 0, Lpile - n)
-        if cns.qual_weighted:
-            votes = build_votes(
-                res.state, res.qrow, res.ins_len, q, qq,
-                res.q_start, res.q_end, keep,
-                ignore_cols=ign, qual_weighted=True,
-                taboo_frac=taboo_frac, taboo_abs=taboo_abs,
-                min_aln_length=cns.min_aln_length)
-            pileup = pileup_accumulate(
-                pileup, votes, lread[sl], w0p, interpret=interpret)
-        else:
+
+        def _vote(pileup, res=res, q=q, qq=qq, win_start=win_start,
+                  ign=ign, sl=sl):
+            keep = admitted[sl]
+            w0p = jnp.clip(win_start + pad, 0, Lpile - n)
+            if cns.qual_weighted:
+                votes = build_votes(
+                    res.state, res.qrow, res.ins_len, q, qq,
+                    res.q_start, res.q_end, keep,
+                    ignore_cols=ign, qual_weighted=True,
+                    taboo_frac=taboo_frac, taboo_abs=taboo_abs,
+                    min_aln_length=cns.min_aln_length)
+                return pileup_accumulate(
+                    pileup, votes, lread[sl], w0p, interpret=interpret)
             words = encode_votes(
                 res.state, res.qrow, res.ins_len, q,
                 res.q_start, res.q_end, ignore_cols=ign,
                 taboo_frac=taboo_frac, taboo_abs=taboo_abs,
                 min_aln_length=cns.min_aln_length)
             words = jnp.where(keep[:, None], words, 0)
-            pileup = pileup_accumulate_packed(
+            return pileup_accumulate_packed(
                 pileup, words, lread[sl], w0p, interpret=interpret)
+
+        if c == 0:
+            pileup = _vote(pileup)
+        else:
+            pileup = jax.lax.cond(
+                jnp.asarray(c * CH, jnp.int32) < n_cand,
+                _vote, lambda p: p, pileup)
 
     pile = unpack_pileup(pileup, pad, Lp)
     if cns.use_ref_qual:
@@ -501,6 +558,121 @@ _fused_pass = functools.partial(
 )(_fused_pass_body)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("m", "W", "CH", "n_chunks", "ap", "cns", "interpret",
+                     "n_rest", "Lp", "seed_stride", "seed_min_votes",
+                     "shortcut_frac", "min_gain"),
+)
+def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
+                     sr_codes, sr_rc, sr_qual, sr_lengths,
+                     sels, mask_pvs,
+                     m: int, W: int, CH: int, n_chunks: int,
+                     ap: AlignParams, cns: ConsensusParams,
+                     interpret: bool, n_rest: int, Lp: int,
+                     seed_stride: int, seed_min_votes: int,
+                     shortcut_frac: float, min_gain: float):
+    """Iterations 2..N as ONE device program (``lax.while_loop``).
+
+    The host loop pays one blocking round trip per pass on the tunneled
+    device (~150-250ms each) just to read the masked-% KPI that drives the
+    reference's mask shortcut (``bin/proovread:2026-2047``); here the
+    shortcut decision itself moves on device, so the whole remaining
+    iteration schedule costs a single dispatch + one result fetch.
+
+    ``sels``: i32 [n_rest, Rsel] per-iteration sampled short-read rows
+    (pad rows point at the zero-length sentinel read). ``mask_pvs``: f32
+    [n_rest, 6] per-iteration HCR mask params (``mask_params_vec`` —
+    early/late iterations mask differently). Returns the final read state
+    plus stacked per-iteration (frac, n_cand, n_admitted) and the number
+    of iterations actually run."""
+    B = codes.shape[0]
+
+    def one_pass(codes, qual, lengths, mask_cols, it):
+        sel = sels[it]
+        qc = sr_codes[sel]
+        rcq = sr_rc[sel]
+        qq = sr_qual[sel]
+        qlen = sr_lengths[sel]
+
+        map_codes = jnp.where(mask_cols, jnp.int8(N), codes)
+        index = dseed.device_index(map_codes, lengths, ap.min_seed_len)
+        cand = dseed.probe_candidates(
+            index, qc, qlen, rcq, ap,
+            stride=seed_stride, min_votes=seed_min_votes)
+        sread, strand, lread, diag, n_valid = \
+            dseed.compact_candidates(cand)
+        R_need = n_chunks * CH
+        sread, strand, lread, diag = _pad_candidates(
+            sread, strand, lread, diag, R_need)
+        n_cand = jnp.minimum(n_valid, R_need).astype(jnp.int32)
+
+        call, n_adm, _, _ = _fused_pass_body(
+            map_codes.reshape(-1), mask_cols.reshape(-1),
+            codes, qual, lengths, qc, rcq, qq, qlen,
+            sread, strand, lread, diag, n_cand,
+            m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
+            interpret=interpret, collect=False)
+        new_codes, new_qual, new_len = device_assemble(
+            call, qual, lengths, Lp)
+        new_mask, frac = device_hcr_mask_dyn(new_qual, new_len,
+                                             mask_pvs[it])
+        return new_codes, new_qual, new_len, new_mask, frac, n_cand, n_adm
+
+    def cond(state):
+        (_, _, _, _, _, _, it, done, *_rest) = state
+        return (it < n_rest) & ~done
+
+    def body(state):
+        (codes, qual, lengths, mask_cols, frac_prev, _gain, it, done,
+         fracs, ncands, nadms) = state
+        (codes, qual, lengths, mask_cols, frac, n_cand,
+         n_adm) = one_pass(codes, qual, lengths, mask_cols, it)
+        gain = frac - frac_prev
+        done = (frac > shortcut_frac) | (gain < min_gain)
+        fracs = fracs.at[it].set(frac)
+        ncands = ncands.at[it].set(n_cand)
+        nadms = nadms.at[it].set(n_adm)
+        return (codes, qual, lengths, mask_cols, frac, gain, it + 1, done,
+                fracs, ncands, nadms)
+
+    init = (codes, qual, lengths, mask_cols, frac_prev, jnp.float32(0),
+            jnp.int32(0), jnp.bool_(False),
+            jnp.full(n_rest, -1.0, jnp.float32),
+            jnp.zeros(n_rest, jnp.int32),
+            jnp.zeros(n_rest, jnp.int32))
+    (codes, qual, lengths, mask_cols, frac, _gain, it, _done, fracs,
+     ncands, nadms) = jax.lax.while_loop(cond, body, init)
+    return codes, qual, lengths, mask_cols, it, fracs, ncands, nadms
+
+
+def _pad_candidates(sread, strand, lread, diag, R_need: int):
+    """Pad the compacted candidate arrays to exactly ``R_need`` rows
+    (bsw_expand asserts R % block == 0). Pad lreads repeat the last row so
+    read_of stays sorted for the pileup kernel; pad rows are dead."""
+    R0 = sread.shape[0]
+    if R_need > R0:
+        padn = R_need - R0
+        sread = jnp.concatenate([sread, jnp.zeros(padn, sread.dtype)])
+        strand = jnp.concatenate([strand, jnp.zeros(padn, strand.dtype)])
+        lread = jnp.concatenate(
+            [lread, jnp.broadcast_to(lread[-1], (padn,))])
+        diag = jnp.concatenate([diag, jnp.zeros(padn, diag.dtype)])
+    return sread[:R_need], strand[:R_need], lread[:R_need], diag[:R_need]
+
+
+def _bucket_chunks(need: int) -> int:
+    """Smallest {2^k, 3*2^(k-1)} ladder value >= need
+    (1,2,3,4,6,8,12,16,24,...)."""
+    p = 1
+    while True:
+        if need <= p:
+            return p
+        if p >= 2 and need <= p + p // 2:
+            return p + p // 2
+        p *= 2
+
+
 class DeviceCorrector:
     """Chunked device correction over one long-read batch state."""
 
@@ -520,6 +692,8 @@ class DeviceCorrector:
         seed_stride: int = 8, seed_min_votes: int = 2,
         collect_aln: bool = False,
     ):
+        """One correction pass (dynamic chunk count; the multi-pass loop
+        without per-pass host syncs is :func:`fused_iterations`)."""
         import time as _time
         _t0 = _time.time()
         B, Lp = codes.shape
@@ -550,34 +724,23 @@ class DeviceCorrector:
             ignore_flat = mask_cols.reshape(-1)
 
         CH = self.chunk
-        # bucket the chunk count to a power of two: n_chunks is a static
-        # arg of the fused program, so each distinct value is a separate
-        # XLA compile — pow2 bucketing bounds the variants to O(log R) at
-        # the cost of masked dead rows in the rounded-up chunks
-        need = max(1, -(-n_cand // CH))
-        n_chunks = 1
-        while n_chunks < need:
-            n_chunks *= 2
+        # bucket the chunk count: n_chunks is a static arg of the fused
+        # program, so each distinct value is a separate XLA compile — the
+        # {2^k, 3*2^k} ladder bounds variants to O(log R) while capping
+        # dead-row waste at 33% (plain pow2 costs up to 2x on e.g. 5->8)
+        n_chunks = _bucket_chunks(max(1, -(-n_cand // CH)))
         # every chunk slice must have exactly CH rows (bsw_expand asserts
         # R % block == 0); pad the candidate arrays when the slot count is
         # not a chunk multiple. Pad lreads repeat the last row so read_of
         # stays sorted for the pileup kernel; pad rows are dead (>= n_cand).
         R_need = n_chunks * CH
-        R0 = sread.shape[0]
-        if R_need > R0:
-            padn = R_need - R0
-            sread = jnp.concatenate(
-                [sread, jnp.zeros(padn, sread.dtype)])
-            strand = jnp.concatenate(
-                [strand, jnp.zeros(padn, strand.dtype)])
-            lread = jnp.concatenate(
-                [lread, jnp.broadcast_to(lread[-1], (padn,))])
-            diag = jnp.concatenate([diag, jnp.zeros(padn, diag.dtype)])
+        sread, strand, lread, diag = _pad_candidates(
+            sread, strand, lread, diag, R_need)
 
         call, n_admitted, scalars, slabs = _fused_pass(
             map_flat, ignore_flat, codes, qual, lengths,
             q_codes, rc_codes, q_qual, q_lengths,
-            sread[:R_need], strand[:R_need], lread[:R_need], diag[:R_need],
+            sread, strand, lread, diag,
             jnp.asarray(n_cand, jnp.int32),
             m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
             interpret=self.interpret, collect=collect_aln)

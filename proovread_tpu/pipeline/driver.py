@@ -167,30 +167,39 @@ class Pipeline:
         untrimmed: List[SeqRecord] = []
         results_final: List[ConsensusResult] = []
 
-        sr_dev = None
-        Lp = None
         if cfg.engine == "device":
+            # bucket by length: each bucket compiles/pads at its own Lp —
+            # padding every read to the global max wastes quadratically at
+            # real PacBio length spreads (SURVEY §5.7)
             sr_dev = _SrDevice(sr_all)
-            maxlen = max(len(r) for r in kept)
-            want = int(maxlen * (1 + cfg.length_slack)) + 128
-            Lp = max(128, -(-want // 128) * 128)
-
-        for start in range(0, len(kept), cfg.batch_reads):
-            batch_recs = kept[start:start + cfg.batch_reads]
-            if cfg.engine == "device":
+            for pad, batch_recs in _bucket_records(kept, cfg.batch_reads):
+                want = int(pad * (1 + cfg.length_slack)) + 128
+                Lp = max(512, -(-want // 512) * 512)
                 res_batch, chim = self._run_batch_device(
                     batch_recs, sr_dev, len(short_records), sampler,
                     coverage, min_sr_len, reports, Lp)
-            else:
+                results_final.extend(res_batch)
+                all_chim.extend(chim)
+            # restore read_long's natural output order across buckets
+            results_final.sort(key=lambda r: r.record.id)
+            untrimmed.extend(r.record for r in results_final)
+        else:
+            for start in range(0, len(kept), cfg.batch_reads):
+                batch_recs = kept[start:start + cfg.batch_reads]
                 res_batch, chim = self._run_batch(
                     batch_recs, sr_all, short_records, sampler, coverage,
                     min_sr_len, reports)
-            results_final.extend(res_batch)
-            all_chim.extend(chim)
-            untrimmed.extend(r.record for r in res_batch)
+                results_final.extend(res_batch)
+                all_chim.extend(chim)
+                untrimmed.extend(r.record for r in res_batch)
 
         trimmed = trim_records(results_final, cfg.trim)
         return PipelineResult(untrimmed, trimmed, ignored, all_chim, reports)
+
+    def _batch_rows(self, n: int) -> int:
+        """Round the batch row count up to a multiple of 32 (bounds jit
+        variants while not padding tiny buckets to the full batch)."""
+        return min(self.config.batch_reads, max(32, -(-n // 32) * 32))
 
     def _run_batch_device(self, batch_recs, sr_dev, n_short, sampler,
                           coverage, min_sr_len, reports, Lp):
@@ -206,7 +215,7 @@ class Pipeline:
         cfg = self.config
         B0 = len(batch_recs)
         pad_recs = [SeqRecord(f"_pad{i}", "A" * 8)
-                    for i in range(cfg.batch_reads - B0)]
+                    for i in range(self._batch_rows(B0) - B0)]
         lr = pack_reads(list(batch_recs) + pad_recs, pad_len=Lp)
         if not hasattr(self, "_dc"):
             self._dc = DeviceCorrector(chunk=cfg.device_chunk)
@@ -219,42 +228,93 @@ class Pipeline:
         max_cov = max(int(min(coverage, cfg.sr_coverage)
                           * cfg.coverage_scale + 0.5), 1)
 
-        it = 1
-        while it <= cfg.n_iterations:
-            task = f"bwa-{cfg.mode[:2]}-{it}"
-            ap = _align_params(cfg.mode, it)
-            cns = ConsensusParams(
+        # -- pass 1: eager, dynamic chunk count (learns the candidate
+        # scale + drives bucketing for the fused remainder) ---------------
+        from proovread_tpu.pipeline.dcorrect import (_bucket_chunks,
+                                                     fused_iterations,
+                                                     mask_params_vec)
+        from proovread_tpu.align import bsw as _bsw
+
+        def _iter_cns():
+            return ConsensusParams(
                 qual_weighted=False, use_ref_qual=True,
                 indel_taboo_length=cfg.indel_taboo_length,
                 max_coverage=max_cov,
             )
-            sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
-                if cfg.sampling else np.arange(n_short)
-            qc, rcq, qq, qlen = sr_dev.take(sel)
-            call, stats = dc.correct_pass(
-                codes, qual, lengths, mask_cols, qc, rcq, qq, qlen, ap, cns,
-                seed_stride=cfg.seed_stride)
-            codes, qual, lengths = device_assemble(call, qual, lengths, Lp)
 
-            mp = (cfg.hcr_mask if it < 4
-                  else cfg.hcr_mask_late).scaled(min_sr_len)
-            mask_cols, frac = device_hcr_mask(qual, lengths, mp)
-            # one RPC for the iteration KPI + admission stat
-            new_frac, n_adm = jax.device_get((frac, stats.n_admitted))
-            new_frac = float(new_frac)
-            gain = new_frac - masked_frac
-            masked_frac = new_frac
-            reports.append(TaskReport(task, masked_frac, stats.n_candidates,
-                                      int(n_adm)))
-            log.info("%s: masked %.1f%%", task, masked_frac * 100)
+        def _mask_p(it):
+            return (cfg.hcr_mask if it < 4
+                    else cfg.hcr_mask_late).scaled(min_sr_len)
 
-            it += 1
-            if it <= cfg.n_iterations and (
-                    masked_frac > cfg.mask_shortcut_frac
-                    or gain < cfg.mask_min_gain_frac):
-                log.info("mask shortcut: skipping to finish "
-                         "(masked %.3f, gain %.3f)", masked_frac, gain)
-                break
+        ap = _align_params(cfg.mode, 1)
+        cns = _iter_cns()
+        sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
+            if cfg.sampling else np.arange(n_short)
+        qc, rcq, qq, qlen = sr_dev.take(sel)
+        call, stats = dc.correct_pass(
+            codes, qual, lengths, None, qc, rcq, qq, qlen, ap, cns,
+            seed_stride=cfg.seed_stride)
+        codes, qual, lengths = device_assemble(call, qual, lengths, Lp)
+        mask_cols, frac = device_hcr_mask(qual, lengths, _mask_p(1))
+        new_frac, n_adm, n_c = jax.device_get(
+            (frac, stats.n_admitted, stats.n_candidates))
+        gain = float(new_frac) - masked_frac
+        masked_frac = float(new_frac)
+        task1 = f"bwa-{cfg.mode[:2]}-1"
+        reports.append(TaskReport(task1, masked_frac, int(n_c), int(n_adm)))
+        log.info("%s: masked %.1f%%", task1, masked_frac * 100)
+        # pass 1's count sizes the fused passes' static candidate budget;
+        # 30% headroom because later passes sample DIFFERENT short-read
+        # subsets and reads grow through consensus, so counts can exceed
+        # pass 1's (overflow candidates would be dropped silently)
+        static_chunks = _bucket_chunks(
+            max(1, -(-int(int(n_c) * 1.3) // cfg.device_chunk)))
+
+        n_rest = cfg.n_iterations - 1
+        shortcut = n_rest > 0 and (masked_frac > cfg.mask_shortcut_frac
+                                   or gain < cfg.mask_min_gain_frac)
+        if shortcut:
+            log.info("mask shortcut: skipping to finish "
+                     "(masked %.3f, gain %.3f)", masked_frac, gain)
+        elif n_rest > 0:
+            # -- passes 2..N: ONE device program, shortcut on device ------
+            Rsel = len(sel) if cfg.sampling else n_short
+            Rsel = max(512, -(-Rsel // 512) * 512)
+            sels = np.full((n_rest, Rsel), sr_dev.pad_idx, np.int32)
+            pvs = np.zeros((n_rest, 6), np.float32)
+            for k in range(n_rest):
+                it_k = k + 2
+                s = (sampler.select(n_short, coverage, cfg.sr_coverage)
+                     if cfg.sampling else np.arange(n_short))
+                sels[k, :len(s)] = s[:Rsel]
+                pvs[k] = np.asarray(mask_params_vec(_mask_p(it_k)))
+            # passes 2..N share one schedule entry (sr: BWA_SR throughout;
+            # mr: BWA_MR after the looser BWA_MR_1 opener) — resolve it
+            # for iteration 2, NOT iteration 1 (bin/proovread:1989-2024)
+            ap_rest = _align_params(cfg.mode, 2)
+            out = fused_iterations(
+                codes, qual, lengths, mask_cols, jnp.float32(masked_frac),
+                sr_dev.codes, sr_dev.rc, sr_dev.qual, sr_dev.lengths,
+                jnp.asarray(sels), jnp.asarray(pvs),
+                m=sr_dev.codes.shape[1], W=_bsw.band_lanes(ap_rest),
+                CH=cfg.device_chunk, n_chunks=static_chunks, ap=ap_rest,
+                cns=cns, interpret=dc.interpret, n_rest=n_rest, Lp=Lp,
+                seed_stride=cfg.seed_stride, seed_min_votes=2,
+                shortcut_frac=cfg.mask_shortcut_frac,
+                min_gain=cfg.mask_min_gain_frac)
+            codes, qual, lengths, mask_cols = out[:4]
+            # ONE RPC for the whole remaining schedule's KPIs
+            n_done, fracs, ncands, nadms = jax.device_get(out[4:])
+            for k in range(int(n_done)):
+                masked_frac = float(fracs[k])
+                reports.append(TaskReport(
+                    f"bwa-{cfg.mode[:2]}-{k + 2}", masked_frac,
+                    int(ncands[k]), int(nadms[k])))
+                log.info("bwa-%s-%d: masked %.1f%%", cfg.mode[:2], k + 2,
+                         masked_frac * 100)
+            if int(n_done) < n_rest:
+                log.info("mask shortcut: skipped to finish on device "
+                         "(masked %.3f)", masked_frac)
 
         # finish: strict params, UNMASKED ref, no ref-qual recycling,
         # chimera detection (bin/proovread:1573-1579)
@@ -403,6 +463,49 @@ class Pipeline:
 
         chim = [(o.record.id, f, t, s) for o in out for (f, t, s) in o.chimera]
         return out, chim
+
+
+def _bucket_records(kept, batch_size: int,
+                    bounds=(512, 1024, 2048, 4096, 8192, 16384, 32768)):
+    """[(group_max_len, records)] batches, grouped by length bucket.
+
+    Bounds only GROUP reads of similar length; the returned pad hint is the
+    group's actual max length, so a near-uniform input pays no extra
+    padding. Groups smaller than a quarter batch merge into the next
+    larger bucket — each group runs its own iteration loop, and tiny
+    groups would pay the loop's per-pass latency for a handful of reads."""
+    import bisect
+    groups: Dict[int, List[SeqRecord]] = {}
+    for r in kept:
+        i = bisect.bisect_left(bounds, len(r))
+        pad = bounds[i] if i < len(bounds) else \
+            -(-len(r) // bounds[-1]) * bounds[-1]
+        groups.setdefault(pad, []).append(r)
+
+    merged: List[List[SeqRecord]] = []
+    pending: List[SeqRecord] = []
+    for pad in sorted(groups):
+        pending.extend(groups[pad])
+        if len(pending) >= max(1, batch_size // 4):
+            merged.append(pending)
+            pending = []
+    if pending:
+        # a trailing undersized group holds the LONGEST reads — merging it
+        # down into a shorter group would pad that whole group to the long
+        # reads' length, recreating the waste bucketing exists to avoid.
+        # Merge down only when the lengths are comparable (<=2x).
+        if merged and max(len(r) for r in pending) <= \
+                2 * max(len(r) for r in merged[-1]):
+            merged[-1].extend(pending)
+        else:
+            merged.append(pending)
+
+    out = []
+    for recs in merged:
+        for j in range(0, len(recs), batch_size):
+            group = recs[j:j + batch_size]
+            out.append((max(len(r) for r in group), group))
+    return out
 
 
 def _take_batch(batch: ReadBatch, idx: np.ndarray) -> ReadBatch:

@@ -1,0 +1,115 @@
+"""Subread circular consensus (ccs-1 task, ``bin/ccseq`` role).
+
+Parity targets: ZMW id grouping (``ccseq:238``), reference-subread
+selection (longest of 2, else 2nd of >2, ``:356-366``), singles
+pass-through, secondaries dropped, consensus improves the reference
+subread toward the molecule's true sequence.
+"""
+
+import numpy as np
+import pytest
+
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.pipeline.ccs import (ccs_correct, is_subread_set, zmw_of)
+
+BASES = "ACGT"
+
+
+def _identity(a: str, b: str) -> float:
+    import difflib
+    sm = difflib.SequenceMatcher(None, a.upper(), b.upper(), autojunk=False)
+    return sum(m.size for m in sm.get_matching_blocks()) / max(
+        len(a), len(b), 1)
+
+
+def _noisy(rng, true: str, err: float) -> str:
+    out = []
+    for c in true:
+        u = rng.random()
+        if u < err * 0.3:
+            continue
+        if u < err * 0.5:
+            out.append(BASES[int(rng.integers(0, 4))])
+        if u < err:
+            out.append(BASES[int(rng.integers(0, 4))])
+        else:
+            out.append(c)
+    return "".join(out)
+
+
+class TestZmwParsing:
+    def test_zmw_of(self):
+        assert zmw_of("m1305_2/4500/0_1000") == "m1305_2/4500"
+        assert zmw_of("m1305_2/4500/1100_2000") == "m1305_2/4500"
+        assert zmw_of("read_17") is None
+
+    def test_is_subread_set(self):
+        subs = [SeqRecord("m1/1/0_5", "ACGTA"),
+                SeqRecord("m1/2/0_5", "ACGTA")]
+        assert is_subread_set(subs)
+        assert not is_subread_set(subs + [SeqRecord("plain", "ACGT")])
+        assert not is_subread_set([])
+
+
+class TestCcsCorrect:
+    def _zmw(self, rng, true, hole, n_subs, err=0.08):
+        recs = []
+        pos = 0
+        for k in range(n_subs):
+            seq = _noisy(rng, true, err)
+            recs.append(SeqRecord(f"m9/{hole}/{pos}_{pos + len(seq)}", seq,
+                                  qual=np.full(len(seq), 8, np.uint8)))
+            pos += len(seq) + 40
+        return recs
+
+    def test_consensus_improves_identity(self):
+        rng = np.random.default_rng(21)
+        true = "".join(BASES[i] for i in rng.integers(0, 4, 900))
+        recs = self._zmw(rng, true, hole=10, n_subs=4)
+        out, stats = ccs_correct(recs)
+        assert stats.primary == 1
+        assert stats.secondary == 3
+        assert len(out) == 1
+        before = max(_identity(r.seq, true) for r in recs)
+        after = _identity(out[0].seq, true)
+        assert after > before, (before, after)
+        assert after > 0.97
+
+    def test_single_passthrough_and_mixed_order(self):
+        rng = np.random.default_rng(22)
+        t1 = "".join(BASES[i] for i in rng.integers(0, 4, 700))
+        t2 = "".join(BASES[i] for i in rng.integers(0, 4, 700))
+        multi = self._zmw(rng, t1, hole=1, n_subs=3)
+        single = SeqRecord("m9/2/0_700", t2,
+                           qual=np.full(len(t2), 8, np.uint8))
+        recs = [multi[0], single, multi[1], multi[2]]
+        out, stats = ccs_correct(recs)
+        assert stats.single == 1
+        assert stats.primary == 1
+        # output order = first-seen ZMW order
+        assert len(out) == 2
+        assert zmw_of(out[0].id) == "m9/1"
+        assert out[1].seq == t2                 # untouched pass-through
+
+    def test_ref_selection_longest_of_two(self):
+        rng = np.random.default_rng(23)
+        true = "".join(BASES[i] for i in rng.integers(0, 4, 600))
+        short = SeqRecord("m9/5/0_300", true[:300],
+                          qual=np.full(300, 8, np.uint8))
+        long_ = SeqRecord("m9/5/400_1000", true,
+                          qual=np.full(len(true), 8, np.uint8))
+        out, stats = ccs_correct([short, long_])
+        assert len(out) == 1
+        # reference = the longer subread; output retains its id
+        assert out[0].id == long_.id
+
+    def test_ref_selection_second_of_many(self):
+        rng = np.random.default_rng(24)
+        true = "".join(BASES[i] for i in rng.integers(0, 4, 600))
+        recs = self._zmw(rng, true, hole=7, n_subs=3)
+        out, _ = ccs_correct(recs)
+        assert out[0].id == recs[1].id          # 2nd of >2 (ccseq:356-366)
+
+    def test_non_subread_raises(self):
+        with pytest.raises(ValueError, match="subread"):
+            ccs_correct([SeqRecord("plain_read", "ACGT" * 50)])

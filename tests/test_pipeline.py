@@ -11,7 +11,46 @@ from proovread_tpu.pipeline import (
     CoverageSampler, MaskParams, Pipeline, PipelineConfig, TrimParams,
     hcr_intervals, mask_batch,
 )
+from proovread_tpu.pipeline.driver import _bucket_records
 from proovread_tpu.pipeline.trim import split_chimera, trim_window
+
+
+class TestBucketRecords:
+    def test_uniform_input_single_group(self):
+        recs = [SeqRecord(f"r{i}", "A" * 1000) for i in range(100)]
+        out = _bucket_records(recs, batch_size=128)
+        assert len(out) == 1
+        pad, group = out[0]
+        assert pad == 1000 and len(group) == 100
+
+    def test_skewed_input_splits_groups(self):
+        recs = ([SeqRecord(f"s{i}", "A" * 600) for i in range(64)]
+                + [SeqRecord(f"l{i}", "A" * 9000) for i in range(64)])
+        out = _bucket_records(recs, batch_size=128)
+        assert len(out) == 2
+        assert sorted(p for p, _ in out) == [600, 9000]
+        # without bucketing the 64 short reads would pad to 9000 (15x waste)
+
+    def test_tiny_bucket_merges_up(self):
+        recs = ([SeqRecord(f"s{i}", "A" * 400) for i in range(3)]
+                + [SeqRecord(f"l{i}", "A" * 3000) for i in range(70)])
+        out = _bucket_records(recs, batch_size=128)
+        assert len(out) == 1            # 3 shorts merge into the 3k group
+        assert out[0][0] == 3000 and len(out[0][1]) == 73
+
+    def test_batch_split(self):
+        recs = [SeqRecord(f"r{i}", "A" * 1000) for i in range(300)]
+        out = _bucket_records(recs, batch_size=128)
+        assert [len(g) for _, g in out] == [128, 128, 44]
+
+    def test_trailing_long_reads_get_own_group(self):
+        """A few very long reads at the tail must NOT merge down into a
+        short-read group (that would pad the whole group to their
+        length)."""
+        recs = ([SeqRecord(f"s{i}", "A" * 600) for i in range(120)]
+                + [SeqRecord(f"l{i}", "A" * 20000) for i in range(4)])
+        out = _bucket_records(recs, batch_size=128)
+        assert sorted(p for p, _ in out) == [600, 20000]
 
 
 class TestMasking:
