@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--lr-min-length", type=int,
                     help="min long-read length (0 disables; default 2x "
                          "median short-read length)")
+    ap.add_argument("--ignore-sr-length", action="store_true",
+                    help="accept short reads longer than 1000bp "
+                         "(bin/proovread:457-464 guard)")
+    ap.add_argument("--haplo-coverage", type=float,
+                    help="per-read coverage cutoff for uneven-coverage "
+                         "data (proovread-flex role; sam/bam modes)")
     ap.add_argument("--no-sampling", action="store_true",
                     help="use all short reads every iteration")
     ap.add_argument("--overwrite", action="store_true",
@@ -129,6 +135,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     sr_lens = np.array([len(r) for r in shorts]) if shorts else np.zeros(0)
     min_sr_len = int(np.median(sr_lens)) if len(sr_lens) else 0
 
+    # preflight (bin/proovread:457-464,586-592): catch mis-supplied inputs
+    # before any compile time is spent
+    if len(sr_lens) and sr_lens.max() > 1000 and not args.ignore_sr_length:
+        print(f"error: short reads up to {int(sr_lens.max())}bp — is -s the "
+              "right file? (--ignore-sr-length to proceed)",
+              file=sys.stderr)
+        return 2
+    too_long = [r.id for r in longs if len(r.id) > 256]
+    if too_long:
+        print(f"error: read id longer than 256 chars: {too_long[0]!r}",
+              file=sys.stderr)
+        return 2
+    import jax
+    log.info("preflight: %d device(s), platform %s",
+             jax.device_count(), jax.devices()[0].platform)
+
     mode = args.mode
     if mode == "auto":
         mode = mode_auto(min_sr_len, bool(utgs), _have_subreads(longs),
@@ -151,7 +173,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg, mode, tasks, longs, shorts, utgs,
         sam=args.sam, bam=args.bam, coverage=args.coverage,
         lr_min_length=args.lr_min_length,
-        sampling=not args.no_sampling)
+        sampling=not args.no_sampling,
+        haplo_coverage=args.haplo_coverage)
 
     # -- reference output layout (bin/proovread:904-956) -----------------
     from proovread_tpu.io.fasta import FastaWriter

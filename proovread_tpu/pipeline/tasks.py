@@ -94,6 +94,7 @@ def run_tasks(
     coverage: Optional[float] = None,
     lr_min_length: Optional[int] = None,
     sampling: bool = True,
+    haplo_coverage: Optional[float] = None,
 ) -> PipelineResult:
     reports: List[TaskReport] = []
 
@@ -138,6 +139,7 @@ def run_tasks(
             params=params,
             detect_chimera=bool(cfg.get("detect-chimera", task)),
             max_ref_seqs=int(cfg.get("chunk-size")),
+            haplo_coverage=haplo_coverage,
         )
         t0 = time.time()
         results = list(sam2cns(src, longs, s2c))

@@ -1,0 +1,49 @@
+"""Small standalone stream tools mirroring the reference's worker scripts.
+
+``samfilter``: the role of ``bin/samfilter`` (drop unmapped records, restore
+secondary-alignment seq/qual from the primary — incl. revcomp — default
+qual '?' when absent, ``bin/samfilter:41-72``).
+
+Run as ``python -m proovread_tpu.tools samfilter in.sam|in.bam [out.sam]``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+def samfilter(argv: List[str]) -> int:
+    from proovread_tpu.io.sam import SamReader, SamWriter, restore_secondary
+
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m proovread_tpu.tools samfilter "
+              "<in.sam|in.bam> [out.sam]", file=sys.stderr)
+        return 2
+    reader = SamReader(argv[0])
+    out = SamWriter(argv[1] if len(argv) > 1 else sys.stdout,
+                    header=reader.header)
+    n = 0
+    for rec in restore_secondary(iter(reader)):
+        out.write(rec)
+        n += 1
+    out.close()
+    print(f"samfilter: {n} records", file=sys.stderr)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m proovread_tpu.tools <samfilter> ...",
+              file=sys.stderr)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "samfilter":
+        return samfilter(rest)
+    print(f"unknown tool {cmd!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
