@@ -162,7 +162,12 @@ class Pipeline:
             coverage = sum(len(r) for r in short_records) / max(total_lr, 1)
 
         sampler = CoverageSampler()
-        sr_all = pack_reads(short_records)
+        # queries pad to an 8-row multiple, not 128: the bsw kernel runs
+        # one DP step per padded query row, so 100bp reads at pad 128
+        # would waste 28% of the forward pass
+        sr_all = pack_reads(short_records,
+                            pad_multiple=8 if cfg.engine == "device"
+                            else 128)
 
         untrimmed: List[SeqRecord] = []
         results_final: List[ConsensusResult] = []

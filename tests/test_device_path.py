@@ -119,6 +119,31 @@ class TestBswParity:
         np.testing.assert_array_equal(np.asarray(rb.r_end),
                                       np.asarray(rs.r_end))
 
+    def test_two_half_block_matches_single_half(self):
+        """R >= 256 runs the interleaved two-half block; it must produce
+        exactly what two independent single-half blocks produce."""
+        _, q, win, _, qlen, _, _ = _make_candidates(seed=3, err=0.15)
+        q2 = np.concatenate([q, q[::-1]])          # 256 rows
+        win2 = np.concatenate([win, win[::-1]])
+        qlen2 = np.concatenate([qlen, qlen[::-1]])
+        params = AlignParams()
+        full = bsw.bsw_expand(jnp.asarray(q2), jnp.asarray(win2),
+                              jnp.asarray(qlen2), params, interpret=True)
+        half = bsw.bsw_expand(jnp.asarray(q), jnp.asarray(win),
+                              jnp.asarray(qlen), params, interpret=True)
+        np.testing.assert_array_equal(np.asarray(full.score[:128]),
+                                      np.asarray(half.score))
+        np.testing.assert_array_equal(np.asarray(full.score[128:]),
+                                      np.asarray(half.score)[::-1])
+        np.testing.assert_array_equal(np.asarray(full.state[:128]),
+                                      np.asarray(half.state))
+        np.testing.assert_array_equal(np.asarray(full.qrow[128:]),
+                                      np.asarray(half.qrow)[::-1])
+        np.testing.assert_array_equal(np.asarray(full.ins_len[:128]),
+                                      np.asarray(half.ins_len))
+        np.testing.assert_array_equal(np.asarray(full.r_start[128:]),
+                                      np.asarray(half.r_start)[::-1])
+
     def test_band_lanes_guard(self):
         wide = AlignParams(band_width=80)   # 160 -> 160 lanes > 128
         W = bsw.band_lanes(wide)
