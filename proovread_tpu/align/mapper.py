@@ -12,7 +12,7 @@ semantics while the expensive extension runs as one batched kernel.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional
 
 import numpy as np
 import jax.numpy as jnp

@@ -10,18 +10,18 @@ the consensus cigar (-I, +D: ``bin/bam2cns:461-491``).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from proovread_tpu.consensus.alnset import AlnSet
-from proovread_tpu.consensus.cigar import ColumnStates, expand_alignment, freqs_to_phreds, phreds_to_freqs
+from proovread_tpu.consensus.cigar import ColumnStates, expand_alignment, phreds_to_freqs
 from proovread_tpu.consensus.params import ConsensusParams
 from proovread_tpu.io.batch import ReadBatch
 from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.ops import pileup as pileup_ops
 from proovread_tpu.ops.consensus_call import call_consensus
-from proovread_tpu.ops.encode import GAP, N_STATES, decode_codes
+from proovread_tpu.ops.encode import N_STATES, decode_codes
 
 import jax.numpy as jnp
 

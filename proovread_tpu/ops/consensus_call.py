@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from proovread_tpu.consensus.params import MAX_PHRED, PROOVREAD_CONSTANT
-from proovread_tpu.ops.encode import GAP, N_STATES
+from proovread_tpu.ops.encode import GAP
 from proovread_tpu.ops.pileup import Pileup
 
 

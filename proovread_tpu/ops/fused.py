@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from proovread_tpu.align.sw import OP_D, OP_I, OP_M, OP_NONE
-from proovread_tpu.ops.encode import GAP, N_STATES
+from proovread_tpu.ops.encode import GAP
 from proovread_tpu.ops.pileup import Pileup
 
 

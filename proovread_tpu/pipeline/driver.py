@@ -470,6 +470,11 @@ class Pipeline:
         return out, chim
 
 
+# batch-rows x padded-length budget for one device batch (~0.5M cells ~=
+# 2.1GB of packed pileup at 64 f32 lanes/cell)
+CELL_BUDGET = 128 * 4096
+
+
 def _bucket_records(kept, batch_size: int,
                     bounds=(512, 1024, 2048, 4096, 8192, 16384, 32768)):
     """[(group_max_len, records)] batches, grouped by length bucket.
@@ -507,8 +512,13 @@ def _bucket_records(kept, batch_size: int,
 
     out = []
     for recs in merged:
-        for j in range(0, len(recs), batch_size):
-            group = recs[j:j + batch_size]
+        # cap rows so B x Lp stays bounded: the pileup holds 64 f32 lanes
+        # per cell, so a 128-row batch of 60kb reads would need ~150GB —
+        # long buckets must trade batch rows for length (SURVEY §5.7)
+        gmax = max(len(r) for r in recs)
+        eff = max(8, min(batch_size, CELL_BUDGET // max(gmax, 1)))
+        for j in range(0, len(recs), eff):
+            group = recs[j:j + eff]
             out.append((max(len(r) for r in group), group))
     return out
 

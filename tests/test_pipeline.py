@@ -27,9 +27,12 @@ class TestBucketRecords:
         recs = ([SeqRecord(f"s{i}", "A" * 600) for i in range(64)]
                 + [SeqRecord(f"l{i}", "A" * 9000) for i in range(64)])
         out = _bucket_records(recs, batch_size=128)
-        assert len(out) == 2
-        assert sorted(p for p, _ in out) == [600, 9000]
         # without bucketing the 64 short reads would pad to 9000 (15x waste)
+        assert sorted(set(p for p, _ in out)) == [600, 9000]
+        assert sum(len(g) for _, g in out) == 128
+        # the 9kb groups respect the cell budget (rows shrink, not pad)
+        from proovread_tpu.pipeline.driver import CELL_BUDGET
+        assert all(len(g) * p <= CELL_BUDGET for p, g in out)
 
     def test_tiny_bucket_merges_up(self):
         recs = ([SeqRecord(f"s{i}", "A" * 400) for i in range(3)]
@@ -42,6 +45,17 @@ class TestBucketRecords:
         recs = [SeqRecord(f"r{i}", "A" * 1000) for i in range(300)]
         out = _bucket_records(recs, batch_size=128)
         assert [len(g) for _, g in out] == [128, 128, 44]
+
+    def test_long_reads_shrink_batch_rows(self):
+        """kb-scale reads trade batch rows for length so B x Lp stays
+        within the device cell budget."""
+        recs = [SeqRecord(f"r{i}", "A" * 60000) for i in range(40)]
+        out = _bucket_records(recs, batch_size=128)
+        from proovread_tpu.pipeline.driver import CELL_BUDGET
+        for pad, group in out:
+            assert len(group) * pad <= CELL_BUDGET
+            assert len(group) >= 8
+        assert sum(len(g) for _, g in out) == 40
 
     def test_trailing_long_reads_get_own_group(self):
         """A few very long reads at the tail must NOT merge down into a
