@@ -49,6 +49,16 @@ class Alignment:
             self._span = ref_span(self.ops, self.lens)
         return self._span
 
+    @property
+    def q_len(self) -> int:
+        """Aligned query length (M+I) — what ``Sam::Alignment::length``
+        returns for un-clipped records; the contained/rep-region filters
+        range-test with THIS, not the reference span
+        (Sam/Seq.pm:995,1008)."""
+        from proovread_tpu.consensus.cigar import I, M
+        keep = (self.ops == M) | (self.ops == I)
+        return int(self.lens[keep].sum())
+
     def effective_score(self, invert: bool) -> Optional[float]:
         if self.score is None:
             return None
@@ -213,7 +223,7 @@ class AlnSet:
             rwin.append([lo, min(s + ln + 150, self.ref_len) - lo])
         if not rwin:
             return
-        keep = np.array([not _is_in_range((a.pos0, a.span), rwin)
+        keep = np.array([not _is_in_range((a.pos0, a.q_len), rwin)
                          for a in self.alns], bool)
         self.alns = [a for a, k in zip(self.alns, keep) if k]
         if self.aln_bins is not None:       # keep admission bookkeeping sync
@@ -229,10 +239,11 @@ class AlnSet:
         (Sam/Seq.pm:1001-1047)."""
         inv = self.params.invert_scores
         alns = list(self.alns)
-        # queue sorted by span descending; pop shortest from the tail
-        order = sorted(range(len(alns)), key=lambda i: -alns[i].span)
+        # queue sorted by aligned query length descending; pop shortest
+        # from the tail (the reference ranges on Sam::Alignment::length)
+        order = sorted(range(len(alns)), key=lambda i: -alns[i].q_len)
         iids = [i for i in order]
-        coords = [[alns[i].pos0, alns[i].span] for i in order]
+        coords = [[alns[i].pos0, alns[i].q_len] for i in order]
         scores = [alns[i].effective_score(inv) or 0.0 for i in order]
         removed = set()
         while len(iids) > 1:

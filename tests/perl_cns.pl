@@ -60,6 +60,9 @@ for my $id (@ids) {
     for my $aln (@{$alns{$id} // []}) {
         $o{'utg-mode'} ? $sso->add_aln($aln) : $sso->add_aln_by_score($aln);
     }
+    # utg mode: contained-alignment filter before consensus
+    # (bin/bam2cns:398-422)
+    $sso->filter_contained_alns if $o{'utg-mode'};
     my $con = $sso->consensus(
         use_ref_qual  => $o{'use-ref-qual'},
         qual_weighted => $o{'qual-weighted'},
