@@ -455,3 +455,82 @@ class TestDeviceCorrectorE2E:
             np.testing.assert_array_equal(
                 decode_codes(codes2[i, :int(len2[i])]).encode(),
                 host.record.seq.encode())
+
+
+class TestFusedIterations:
+    """fused_iterations (passes 2..N as one lax.while_loop program) must
+    produce exactly the sequential correct_pass + assemble + mask chain."""
+
+    def _data(self, seed=31):
+        rng = np.random.default_rng(seed)
+        B, Lp, m = 4, 512, 104
+        bases = "ACGT"
+        longs, srs = [], []
+        for i in range(B):
+            genome = "".join(bases[k] for k in rng.integers(0, 4, 400))
+            seq = list(genome)
+            for mu in np.flatnonzero(rng.random(400) < 0.04):
+                seq[mu] = bases[int(rng.integers(0, 4))]
+            longs.append(SeqRecord(f"lr{i}", "".join(seq),
+                                   qual=np.full(400, 5, np.uint8)))
+            for p in rng.integers(0, 300, 24):
+                srs.append(SeqRecord(f"s{i}_{p}", genome[p:p + 100],
+                                     qual=np.full(100, 30, np.uint8)))
+        lr = pack_reads(longs, pad_len=Lp)
+        sr = pack_reads(srs, pad_len=m)
+        return lr, sr, Lp, m
+
+    def test_fused_matches_sequential(self):
+        from proovread_tpu.align.params import BWA_SR
+        from proovread_tpu.pipeline.dcorrect import (
+            DeviceCorrector, device_assemble, device_hcr_mask,
+            device_revcomp, fused_iterations, mask_params_vec)
+        from proovread_tpu.pipeline.masking import MaskParams
+
+        lr, sr, Lp, m = self._data()
+        ap = BWA_SR
+        cns = ConsensusParams(use_ref_qual=True, indel_taboo_length=7)
+        mp = MaskParams().scaled(100)
+
+        codes = jnp.asarray(lr.codes)
+        qual = jnp.asarray(lr.qual)
+        lengths = jnp.asarray(lr.lengths)
+        qc = jnp.asarray(sr.codes)
+        qq = jnp.asarray(sr.qual)
+        qlen = jnp.asarray(sr.lengths)
+        rcq = device_revcomp(qc, qlen)
+
+        # sequential: pass 1 then pass 2 through correct_pass
+        dc = DeviceCorrector(chunk=1024)
+        c1, q1, l1 = codes, qual, lengths
+        mask1 = None
+        for _ in range(2):
+            call, _ = dc.correct_pass(c1, q1, l1, mask1, qc, rcq, qq, qlen,
+                                      ap, cns)
+            c1, q1, l1 = device_assemble(call, q1, l1, Lp)
+            mask1, frac1 = device_hcr_mask(q1, l1, mp)
+
+        # fused: pass 1 eager, pass 2 inside fused_iterations
+        c2, q2, l2 = codes, qual, lengths
+        call, _ = dc.correct_pass(c2, q2, l2, None, qc, rcq, qq, qlen,
+                                  ap, cns)
+        c2, q2, l2 = device_assemble(call, q2, l2, Lp)
+        mask2, frac_a = device_hcr_mask(q2, l2, mp)
+        sels = np.arange(len(sr.lengths), dtype=np.int32)[None, :]
+        pvs = np.asarray(mask_params_vec(mp))[None, :]
+        out = fused_iterations(
+            c2, q2, l2, mask2, frac_a, qc, rcq, qq, qlen,
+            jnp.asarray(sels), jnp.asarray(pvs),
+            m=m, W=bsw.band_lanes(ap), CH=1024, n_chunks=1, ap=ap,
+            cns=cns, interpret=True, n_rest=1, Lp=Lp,
+            seed_stride=8, seed_min_votes=2,
+            shortcut_frac=2.0, min_gain=-1.0)
+        c2, q2, l2, mask2 = out[:4]
+        n_done, fracs = out[4], out[5]
+
+        assert int(n_done) == 1
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+        np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+        np.testing.assert_array_equal(np.asarray(mask1), np.asarray(mask2))
+        assert float(fracs[0]) == pytest.approx(float(frac1), abs=1e-6)
