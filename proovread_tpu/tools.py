@@ -1,10 +1,15 @@
-"""Small standalone stream tools mirroring the reference's worker scripts.
+"""Standalone worker tools mirroring the reference's ``bin/`` scripts.
 
-``samfilter``: the role of ``bin/samfilter`` (drop unmapped records, restore
-secondary-alignment seq/qual from the primary — incl. revcomp — default
-qual '?' when absent, ``bin/samfilter:41-72``).
+- ``samfilter``: ``bin/samfilter`` (drop unmapped records, restore
+  secondary-alignment seq/qual from the primary — incl. revcomp — default
+  qual '?' when absent, ``bin/samfilter:41-72``).
+- ``sam2cns``: ``bin/sam2cns``/``bin/bam2cns`` (consensus-correct long
+  reads from an external SAM/BAM mapping).
+- ``ccseq``: ``bin/ccseq`` (collapse PacBio subread ZMWs to circular
+  consensus reads).
+- ``siamaera``: ``bin/siamaera`` (trim reverse-complement self-chimeras).
 
-Run as ``python -m proovread_tpu.tools samfilter in.sam|in.bam [out.sam]``.
+Run as ``python -m proovread_tpu.tools <tool> ...``.
 """
 
 from __future__ import annotations
@@ -32,15 +37,91 @@ def samfilter(argv: List[str]) -> int:
     return 0
 
 
+def _read_any(path: str):
+    from proovread_tpu.io import fasta, fastq
+    import gzip
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as fh:
+        first = fh.read(1)
+    rd = fastq.FastqReader(path) if first == b"@" else \
+        fasta.FastaReader(path)
+    return list(rd)
+
+
+def _write_fq(records, dest: Optional[str]) -> None:
+    from proovread_tpu.io.fastq import FastqWriter
+    fh = open(dest, "wb") if dest else sys.stdout.buffer
+    w = FastqWriter(fh)
+    for r in records:
+        w.write(r)
+    if dest:
+        fh.close()
+
+
+def sam2cns_tool(argv: List[str]) -> int:
+    """bin/sam2cns role: ``sam2cns <in.sam|in.bam> <ref.fq> [out.fq]``."""
+    if len(argv) < 2:
+        print("usage: python -m proovread_tpu.tools sam2cns "
+              "<in.sam|in.bam> <ref.fq|fa> [out.fq]", file=sys.stderr)
+        return 2
+    from proovread_tpu.consensus.params import ConsensusParams
+    from proovread_tpu.pipeline.sam2cns import (Sam2CnsConfig,
+                                                sam2cns_records)
+    refs = _read_any(argv[1])
+    cfg = Sam2CnsConfig(params=ConsensusParams(
+        indel_taboo_length=7, use_ref_qual=True))
+    out, chim = sam2cns_records(argv[0], refs, cfg)
+    _write_fq(out, argv[2] if len(argv) > 2 else None)
+    print(f"sam2cns: {len(out)} reads corrected, {len(chim)} chimera "
+          "breakpoints", file=sys.stderr)
+    return 0
+
+
+def ccseq_tool(argv: List[str]) -> int:
+    """bin/ccseq role: ``ccseq <subreads.fq> [out.fq]``."""
+    if not argv:
+        print("usage: python -m proovread_tpu.tools ccseq "
+              "<subreads.fq> [out.fq]", file=sys.stderr)
+        return 2
+    from proovread_tpu.pipeline.ccs import ccs_correct
+    out, st = ccs_correct(_read_any(argv[0]))
+    _write_fq(out, argv[1] if len(argv) > 1 else None)
+    print(f"ccseq: {st.primary} primary, {st.single} single, "
+          f"{st.secondary} secondary dropped", file=sys.stderr)
+    return 0
+
+
+def siamaera_tool(argv: List[str]) -> int:
+    """bin/siamaera role: ``siamaera <in.fq> [out.fq]``."""
+    if not argv:
+        print("usage: python -m proovread_tpu.tools siamaera "
+              "<in.fq|fa> [out.fq]", file=sys.stderr)
+        return 2
+    from proovread_tpu.pipeline.siamaera import siamaera_filter
+    out, st = siamaera_filter(_read_any(argv[0]))
+    _write_fq(out, argv[1] if len(argv) > 1 else None)
+    print(f"siamaera: {st.checked} checked, {st.trimmed} trimmed, "
+          f"{st.dropped} dropped", file=sys.stderr)
+    return 0
+
+
+_TOOLS = {
+    "samfilter": samfilter,
+    "sam2cns": sam2cns_tool,
+    "ccseq": ccseq_tool,
+    "siamaera": siamaera_tool,
+}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
-        print("usage: python -m proovread_tpu.tools <samfilter> ...",
-              file=sys.stderr)
+        print(f"usage: python -m proovread_tpu.tools "
+              f"<{'|'.join(sorted(_TOOLS))}> ...", file=sys.stderr)
         return 2
     cmd, rest = argv[0], argv[1:]
-    if cmd == "samfilter":
-        return samfilter(rest)
+    if cmd in _TOOLS:
+        return _TOOLS[cmd](rest)
     print(f"unknown tool {cmd!r}", file=sys.stderr)
     return 2
 
