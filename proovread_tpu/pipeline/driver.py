@@ -251,73 +251,82 @@ class Pipeline:
             return (cfg.hcr_mask if it < 4
                     else cfg.hcr_mask_late).scaled(min_sr_len)
 
-        ap = _align_params(cfg.mode, 1)
         cns = _iter_cns()
-        sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
-            if cfg.sampling else np.arange(n_short)
-        qc, rcq, qq, qlen = sr_dev.take(sel)
-        call, stats = dc.correct_pass(
-            codes, qual, lengths, None, qc, rcq, qq, qlen, ap, cns,
-            seed_stride=cfg.seed_stride)
-        codes, qual, lengths = device_assemble(call, qual, lengths, Lp)
-        mask_cols, frac = device_hcr_mask(qual, lengths, _mask_p(1))
-        new_frac, n_adm, n_c = jax.device_get(
-            (frac, stats.n_admitted, stats.n_candidates))
-        gain = float(new_frac) - masked_frac
-        masked_frac = float(new_frac)
-        task1 = f"bwa-{cfg.mode[:2]}-1"
-        reports.append(TaskReport(task1, masked_frac, int(n_c), int(n_adm)))
-        log.info("%s: masked %.1f%%", task1, masked_frac * 100)
-        # pass 1's count sizes the fused passes' static candidate budget;
-        # 30% headroom because later passes sample DIFFERENT short-read
-        # subsets and reads grow through consensus, so counts can exceed
-        # pass 1's (overflow candidates would be dropped silently)
-        static_chunks = _bucket_chunks(
-            max(1, -(-int(int(n_c) * 1.3) // cfg.device_chunk)))
+        ap1 = _align_params(cfg.mode, 1)
+        ap_rest = _align_params(cfg.mode, 2)
+        first_fused = 1 if ap1 == ap_rest else 2
+        if first_fused == 2:
+            # mr mode: the BWA_MR_1 opener uses different align params from
+            # the rest of the schedule, and the fused program is built
+            # around ONE static schedule entry — run pass 1 eagerly
+            sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
+                if cfg.sampling else np.arange(n_short)
+            qc, rcq, qq, qlen = sr_dev.take(sel)
+            call, stats = dc.correct_pass(
+                codes, qual, lengths, None, qc, rcq, qq, qlen, ap1, cns,
+                seed_stride=cfg.seed_stride)
+            codes, qual, lengths = device_assemble(call, qual, lengths, Lp)
+            mask_cols, frac = device_hcr_mask(qual, lengths, _mask_p(1))
+            new_frac, n_adm, n_c = jax.device_get(
+                (frac, stats.n_admitted, stats.n_candidates))
+            gain = float(new_frac) - masked_frac
+            masked_frac = float(new_frac)
+            task1 = f"bwa-{cfg.mode[:2]}-1"
+            reports.append(TaskReport(task1, masked_frac, int(n_c),
+                                      int(n_adm)))
+            log.info("%s: masked %.1f%%", task1, masked_frac * 100)
+            if (masked_frac > cfg.mask_shortcut_frac
+                    or gain < cfg.mask_min_gain_frac):
+                log.info("mask shortcut: skipping to finish "
+                         "(masked %.3f, gain %.3f)", masked_frac, gain)
+                first_fused = cfg.n_iterations + 1   # no fused passes
+        else:
+            mask_cols = jnp.zeros_like(codes, dtype=bool)
 
-        n_rest = cfg.n_iterations - 1
-        shortcut = n_rest > 0 and (masked_frac > cfg.mask_shortcut_frac
-                                   or gain < cfg.mask_min_gain_frac)
-        if shortcut:
-            log.info("mask shortcut: skipping to finish "
-                     "(masked %.3f, gain %.3f)", masked_frac, gain)
-        elif n_rest > 0:
-            # -- passes 2..N: ONE device program, shortcut on device ------
-            Rsel = len(sel) if cfg.sampling else n_short
-            Rsel = max(512, -(-Rsel // 512) * 512)
-            sels = np.full((n_rest, Rsel), sr_dev.pad_idx, np.int32)
-            pvs = np.zeros((n_rest, 6), np.float32)
-            for k in range(n_rest):
-                it_k = k + 2
-                s = (sampler.select(n_short, coverage, cfg.sr_coverage)
-                     if cfg.sampling else np.arange(n_short))
+        n_fused = cfg.n_iterations - first_fused + 1
+        if n_fused > 0:
+            # -- the whole remaining schedule: ONE device program, the
+            # shortcut decision on device, ONE result fetch --------------
+            sels_l = []
+            for _ in range(n_fused):
+                sels_l.append(
+                    sampler.select(n_short, coverage, cfg.sr_coverage)
+                    if cfg.sampling else np.arange(n_short))
+            Rsel = max(max(len(s) for s in sels_l), 512)
+            Rsel = -(-Rsel // 512) * 512
+            sels = np.full((n_fused, Rsel), sr_dev.pad_idx, np.int32)
+            pvs = np.zeros((n_fused, 6), np.float32)
+            for k, s in enumerate(sels_l):
                 sels[k, :len(s)] = s[:Rsel]
-                pvs[k] = np.asarray(mask_params_vec(_mask_p(it_k)))
-            # passes 2..N share one schedule entry (sr: BWA_SR throughout;
-            # mr: BWA_MR after the looser BWA_MR_1 opener) — resolve it
-            # for iteration 2, NOT iteration 1 (bin/proovread:1989-2024)
-            ap_rest = _align_params(cfg.mode, 2)
+                pvs[k] = np.asarray(mask_params_vec(
+                    _mask_p(first_fused + k)))
+            # candidate budget: ~2 per sampled read upper-bounds the
+            # device seeder's output at short-read scale; chunks past the
+            # live count are skipped at runtime (lax.cond), so the
+            # generous cap costs nothing
+            static_chunks = _bucket_chunks(
+                max(1, -(-2 * Rsel // cfg.device_chunk)))
             out = fused_iterations(
                 codes, qual, lengths, mask_cols, jnp.float32(masked_frac),
                 sr_dev.codes, sr_dev.rc, sr_dev.qual, sr_dev.lengths,
                 jnp.asarray(sels), jnp.asarray(pvs),
                 m=sr_dev.codes.shape[1], W=_bsw.band_lanes(ap_rest),
                 CH=cfg.device_chunk, n_chunks=static_chunks, ap=ap_rest,
-                cns=cns, interpret=dc.interpret, n_rest=n_rest, Lp=Lp,
+                cns=cns, interpret=dc.interpret, n_rest=n_fused, Lp=Lp,
                 seed_stride=cfg.seed_stride, seed_min_votes=2,
                 shortcut_frac=cfg.mask_shortcut_frac,
                 min_gain=cfg.mask_min_gain_frac)
             codes, qual, lengths, mask_cols = out[:4]
-            # ONE RPC for the whole remaining schedule's KPIs
+            # ONE RPC for the whole schedule's KPIs
             n_done, fracs, ncands, nadms = jax.device_get(out[4:])
             for k in range(int(n_done)):
                 masked_frac = float(fracs[k])
                 reports.append(TaskReport(
-                    f"bwa-{cfg.mode[:2]}-{k + 2}", masked_frac,
+                    f"bwa-{cfg.mode[:2]}-{first_fused + k}", masked_frac,
                     int(ncands[k]), int(nadms[k])))
-                log.info("bwa-%s-%d: masked %.1f%%", cfg.mode[:2], k + 2,
-                         masked_frac * 100)
-            if int(n_done) < n_rest:
+                log.info("bwa-%s-%d: masked %.1f%%", cfg.mode[:2],
+                         first_fused + k, masked_frac * 100)
+            if int(n_done) < n_fused:
                 log.info("mask shortcut: skipped to finish on device "
                          "(masked %.3f)", masked_frac)
 
