@@ -58,6 +58,8 @@ class BswResult(NamedTuple):
     r_start: jnp.ndarray  # i32 [R] window-relative ref start
     r_end: jnp.ndarray    # i32 [R] one past last aligned window col
     valid: jnp.ndarray    # bool [R]
+    ins_b0: jnp.ndarray   # i32 [R, n] inserted bases 0-9 packed 3b/base
+    ins_b1: jnp.ndarray   # i32 [R, n] inserted bases 10-19 packed 3b/base
 
 
 def _shift_down(x, s, fill):
@@ -82,7 +84,8 @@ def _extract(slab, onehot, fill):
 
 
 def _bsw_kernel(qlen_ref, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
-                stats_ref, dirs_ref, *, m, W, C, p: AlignParams):
+                insb0_ref, insb1_ref, stats_ref, dirs_ref,
+                *, m, W, C, p: AlignParams):
     n = m + W
     match = jnp.float32(p.match)
     mismatch = jnp.float32(p.mismatch)
@@ -174,6 +177,8 @@ def _bsw_kernel(qlen_ref, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
     state_ref[:] = jnp.full((n, C), -1, jnp.int32)
     qrow_ref[:] = jnp.zeros((n, C), jnp.int32)
     inslen_ref[:] = jnp.zeros((n, C), jnp.int32)
+    insb0_ref[:] = jnp.zeros((n, C), jnp.int32)
+    insb1_ref[:] = jnp.zeros((n, C), jnp.int32)
 
     def bwd(t, carry):
         cur_w, mode, done_i, q_start, r_start = carry
@@ -210,6 +215,17 @@ def _bsw_kernel(qlen_ref, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
         qrow_ref[pl.ds(r, W), :] = jnp.where(dmask | mhot, r, qslab)
         islab = inslen_ref[pl.ds(r, W), :]
         inslen_ref[pl.ds(r, W), :] = islab + jnp.where(ihot, 1, 0)
+        # inserted-base emission: the walk visits a run's bases last-to-
+        # first, so shifting left and or-ing at bits 0-2 leaves forward
+        # offset j at bits 3j of b0 (j < 10) / b1 (10 <= j < 20); bases
+        # past 20 fall off the top (= the run's tail, which the vote
+        # builder's INS_CAP window can never reach for real reads)
+        b0slab = insb0_ref[pl.ds(r, W), :]
+        b1slab = insb1_ref[pl.ds(r, W), :]
+        insb1_ref[pl.ds(r, W), :] = jnp.where(
+            ihot, (b1slab << 3) | ((b0slab >> 27) & 7), b1slab)
+        insb0_ref[pl.ds(r, W), :] = jnp.where(
+            ihot, (b0slab << 3) | qbase, b0slab)
 
         started = is_m & ((src == 0) | (r == 0))
         q_start = jnp.where(started, r, q_start)
@@ -275,7 +291,7 @@ def bsw_expand(q, win, qlen, params: AlignParams,
 
     kernel = functools.partial(_bsw_kernel, m=m, W=W, C=C, p=params)
     grid = (R // C,)
-    state, qrow, inslen, stats = pl.pallas_call(
+    state, qrow, inslen, insb0, insb1, stats = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -287,9 +303,13 @@ def bsw_expand(q, win, qlen, params: AlignParams,
             pl.BlockSpec((n, C), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((n, C), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((n, C), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, C), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, C), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((8, C), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
             jax.ShapeDtypeStruct((n, R), jnp.int32),
             jax.ShapeDtypeStruct((n, R), jnp.int32),
             jax.ShapeDtypeStruct((n, R), jnp.int32),
@@ -304,6 +324,7 @@ def bsw_expand(q, win, qlen, params: AlignParams,
         score=stats[0], q_start=stats[1].astype(jnp.int32),
         q_end=stats[2].astype(jnp.int32), r_start=stats[3].astype(jnp.int32),
         r_end=stats[4].astype(jnp.int32), valid=stats[5] > 0.5,
+        ins_b0=insb0.T, ins_b1=insb1.T,
     )
 
 

@@ -72,7 +72,9 @@ def call_consensus(
 
     cand = jnp.concatenate([plain, ins_cand[:, :, None]], axis=-1)  # [B, L, S+1]
     winner = jnp.argmax(cand, axis=-1)
-    max_freq = jnp.take_along_axis(cand, winner[:, :, None], axis=-1)[:, :, 0]
+    # jnp.max == cand[winner] by construction; take_along_axis would lower
+    # to a scalar-core gather (PERF.md)
+    max_freq = jnp.max(cand, axis=-1)
 
     covered = max_freq > 0.0
     is_ins = covered & (winner == S)

@@ -93,6 +93,112 @@ def pileup_accumulate_packed(
     )(read_of, w0, pileup_packed, words3)
 
 
+def _accum_bits_kernel(read_of_ref, w0_ref, pile_in_ref, b0_ref, b1_ref,
+                       pile_out_ref, acc_ref, rcur_ref, sem, *, n, rb):
+    """RB candidates per grid step: the vote bitmask planes expand to the
+    one-hot slab with broadcast+shift (no per-lane compares), and each
+    candidate's slab adds into the target read's pileup row held in a VMEM
+    accumulator, DMA-flushed at read boundaries (the read index lives in
+    SMEM across programs — the sequential grid guarantees ordering)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        rcur_ref[0] = read_of_ref[0]
+        ld = pltpu.make_async_copy(pile_out_ref.at[read_of_ref[0]], acc_ref,
+                                   sem)
+        ld.start()
+        ld.wait()
+
+    b0 = b0_ref[...][:, :, None]                      # [rb, n, 1]
+    b1 = b1_ref[...][:, :, None]
+    P2 = 2 * PACK_LANES
+    W = jnp.concatenate(
+        [jnp.broadcast_to(b0, (rb, n, 32)),
+         jnp.broadcast_to(b1, (rb, n, 32)),
+         jnp.zeros((rb, n, P2 - 64), jnp.int32)], axis=2)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rb, n, P2), 2) & 31
+    vf = ((W >> lane) & 1).astype(jnp.float32)
+
+    for k in range(rb):
+        g = i * rb + k
+        rd = read_of_ref[g]
+
+        @pl.when(rd != rcur_ref[0])
+        def _():
+            prev = rcur_ref[0]
+            wr = pltpu.make_async_copy(acc_ref, pile_out_ref.at[prev], sem)
+            wr.start()
+            wr.wait()
+            nxt = read_of_ref[g]
+            ld = pltpu.make_async_copy(pile_out_ref.at[nxt], acc_ref, sem)
+            ld.start()
+            ld.wait()
+            rcur_ref[0] = nxt
+
+        acc_ref[pl.ds(w0_ref[g], n), :] += vf[k]
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        wr = pltpu.make_async_copy(acc_ref, pile_out_ref.at[rcur_ref[0]], sem)
+        wr.start()
+        wr.wait()
+
+
+PILEUP_BLOCK = 64
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pileup_accumulate_bits(
+    pileup_packed: jnp.ndarray,   # f32 [B, Lp, 2*PACK_LANES]
+    bits0: jnp.ndarray,           # i32 [R, n] vote-lane bits 0-31
+    bits1: jnp.ndarray,           # i32 [R, n] vote-lane bits 32-63
+    read_of: jnp.ndarray,         # i32 [R] sorted ascending
+    w0: jnp.ndarray,              # i32 [R] padded window offset, 8-aligned
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Blocked bitmask twin of :func:`pileup_accumulate_packed` (same vote
+    layout in lanes [0, PACK_LANES); lanes above stay zero): ~rb x fewer
+    grid steps, pileup rows stay in HBM and are DMA'd once per contiguous
+    read run instead of streamed through the block pipeline every program.
+
+    The buffer is 128 lanes wide because the per-read DMA slice must align
+    to the (1, 128) HBM tiling — a 64-lane minor dim is physically padded
+    and Mosaic rejects the unaligned slice. ``w0`` must be 8-aligned so the
+    accumulator read-modify-write hits whole sublane tiles."""
+    B, Lp, P = pileup_packed.shape
+    R, n = bits0.shape
+    rb = PILEUP_BLOCK
+    assert P == 2 * PACK_LANES
+    assert R % rb == 0, (R, rb)
+
+    grid = (R // rb,)
+    kernel = functools.partial(_accum_bits_kernel, n=n, rb=rb)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec((rb, n), lambda i, ro, w: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((rb, n), lambda i, ro, w: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((Lp, P), jnp.float32),
+                pltpu.SMEM((1,), jnp.int32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Lp, P), jnp.float32),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(read_of, w0, pileup_packed, bits0, bits1)
+
+
 def _accum_kernel(read_of_ref, w0_ref, pile_in_ref, votes_ref, pile_out_ref,
                   *, n):
     i = pl.program_id(0)

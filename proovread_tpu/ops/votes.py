@@ -240,6 +240,122 @@ def encode_votes(
     return word
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("taboo_frac", "taboo_abs", "min_aln_length"),
+)
+def encode_votes_packed_bases(
+    state: jnp.ndarray,     # i32 [R, n] window-col state (-1 = none)
+    qrow: jnp.ndarray,      # i32 [R, n] consuming query row
+    ins_len: jnp.ndarray,   # i32 [R, n] inserted bases after the col
+    ins_b0: jnp.ndarray,    # i32 [R, n] inserted bases 0-9, 3 bits each
+    ins_b1: jnp.ndarray,    # i32 [R, n] inserted bases 10-19, 3 bits each
+    q_start: jnp.ndarray,   # i32 [R]
+    q_end: jnp.ndarray,     # i32 [R]
+    ignore_cols: jnp.ndarray | None = None,  # bool [R, n] MCR columns
+    taboo_frac: float = 0.1,
+    taboo_abs: int = 0,
+    min_aln_length: int = 50,
+) -> jnp.ndarray:
+    """Gather-free twin of :func:`encode_votes`: the inserted-base codes
+    arrive pre-packed from the bsw kernel's traceback walk (``BswResult
+    .ins_b0/.ins_b1``) instead of being gathered from the query with
+    ``take_along_axis`` — XLA lowers those gathers to a ~10 ns/element
+    scalar loop, which dominated the whole correction pass (PERF.md).
+
+    Semantics identical to encode_votes for insertion runs up to 20 bases
+    (beyond that the packed words lose the tail; INS_CAP = 6 and real
+    short-read insertions make that unreachable)."""
+    R, n = state.shape
+    K = INS_CAP
+
+    aln_len = q_end - q_start
+    if taboo_abs:
+        taboo = jnp.full((R,), taboo_abs, jnp.int32)
+    else:
+        taboo = jnp.floor(aln_len * taboo_frac + 0.5).astype(jnp.int32)
+    kept_lo = q_start + taboo
+    kept_hi = q_end - taboo
+    ok = (
+        (aln_len > min_aln_length)
+        & ((kept_hi - kept_lo) >= min_aln_length)
+        & ((kept_hi - kept_lo) >= 0.7 * aln_len)
+    )
+
+    # 1D1I quirk rewrite (see encode_votes): the run's first base becomes
+    # the column's M base; the packed words shift right one base
+    gapins = (state == GAP) & (ins_len > 0)
+    qrow = jnp.where(gapins, qrow + 1, qrow)
+    state = jnp.where(gapins, ins_b0 & 7, state)
+    ins_len = jnp.where(gapins, ins_len - 1, ins_len)
+    ins_b0 = jnp.where(gapins, ((ins_b0 >> 3) & 0x07FFFFFF)
+                       | ((ins_b1 & 7) << 27), ins_b0)
+    ins_b1 = jnp.where(gapins, ins_b1 >> 3, ins_b1)
+
+    has_state = state >= 0
+    in_keep = (qrow >= kept_lo[:, None]) & (qrow < kept_hi[:, None])
+    col_ok = ok[:, None]
+    if ignore_cols is not None:
+        col_ok = col_ok & ~ignore_cols
+    live = has_state & in_keep & col_ok
+
+    st = jnp.clip(state, 0, N_STATES - 1)
+    word = jnp.where(live, st + 1, 0)
+
+    first_qi = qrow + 1
+    k0 = jnp.clip(kept_lo[:, None] - first_qi, 0, 1 << 20)
+    kept_len = jnp.minimum(ins_len, kept_hi[:, None] - first_qi)
+    eff_len = jnp.clip(kept_len - k0, 0, 1 << 20)
+    eff_live = col_ok & (ins_len > 0) & (eff_len > 0)
+
+    word |= jnp.where(live & (state != GAP) & eff_live & (k0 == 0), 8, 0)
+    word |= jnp.where(eff_live, jnp.minimum(eff_len, K), 0) << 4
+
+    for k in range(K):
+        j = k0 + k                                     # forward base offset
+        lo = (ins_b0 >> jnp.clip(3 * j, 0, 31)) & 7
+        hi = (ins_b1 >> jnp.clip(3 * (j - 10), 0, 31)) & 7
+        b_k = jnp.where(j < 10, lo, hi)
+        # offsets past the 20 packed bases abstain (field 5) instead of
+        # voting the garbage the shifted-out words would decode to; the
+        # original gather path would vote the true base here, so runs > 20
+        # bases lose (only) these tail votes — a documented deviation
+        b_field = jnp.where(eff_live & (k < eff_len) & (j < 20),
+                            jnp.clip(b_k, 0, 4), 5)
+        word |= b_field << (7 + 3 * k)
+
+    return word
+
+
+@jax.jit
+def word_to_bits(word: jnp.ndarray):
+    """Packed i32 vote words [R, n] -> vote bitmask as TWO i32 planes
+    (bits 0-31 and 32-63 of the lane space; lanes above 53 are never used).
+
+    Bit g (plane g >> 5, bit g & 31) set <=> vote lane g of the PACK_LANES
+    layout gets a +1 vote. This moves the expensive one-hot construction off
+    the pileup kernel's wide arrays: building the mask costs ~30 ops on the
+    narrow [R, n] arrays, and the kernel expands it with a handful of
+    broadcast+shift ops instead of per-lane compares."""
+    w = word.astype(jnp.int32)
+    st_f = w & 7
+    len_f = (w >> 4) & 7
+    zero = jnp.zeros_like(w)
+
+    b0 = jnp.where(st_f > 0, 1 << (st_f - 1), zero)
+    b0 |= jnp.where((st_f > 0) & (((w >> 3) & 1) > 0), 1 << (8 + st_f - 1),
+                    zero)
+    b0 |= jnp.where(len_f > 0, 1 << (16 + len_f - 1), zero)
+    b1 = zero
+    for k in range(INS_CAP):
+        b_f = (w >> (7 + 3 * k)) & 7                  # 5 = none
+        g = 24 + 5 * k + b_f                          # global vote lane
+        live = (b_f < 5) & (len_f > 0)
+        b0 |= jnp.where(live & (g < 32), 1 << (g & 31), zero)
+        b1 |= jnp.where(live & (g >= 32), 1 << (g & 31), zero)
+    return b0, b1
+
+
 def unpack_pileup(pileup_packed: jnp.ndarray, pad: int, length: int):
     """Packed [B, pad + L + pad, PACK_LANES] -> Pileup tensors."""
     from proovread_tpu.ops.pileup import Pileup

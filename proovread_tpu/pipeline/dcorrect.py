@@ -43,9 +43,10 @@ from proovread_tpu.ops.consensus_call import ConsensusCall, call_consensus
 from proovread_tpu.ops.encode import N
 from proovread_tpu.ops.fused import add_ref_votes
 from proovread_tpu.ops.pileup_kernel import (pileup_accumulate,
-                                             pileup_accumulate_packed)
-from proovread_tpu.ops.votes import (PACK_LANES, build_votes, encode_votes,
-                                     unpack_pileup)
+                                             pileup_accumulate_bits)
+from proovread_tpu.ops.votes import (PACK_LANES, build_votes,
+                                     encode_votes_packed_bases,
+                                     unpack_pileup, word_to_bits)
 from proovread_tpu.pipeline.masking import MaskParams
 
 log = logging.getLogger("proovread_tpu")
@@ -404,7 +405,12 @@ def _gather_and_align(map_flat, q_codes, rc_codes, q_qual, q_lengths,
     qual = jnp.where(strand[:, None] == 0, qual_f, qual_r)
     qlen = q_lengths[sread]
 
-    win_start = diag - W // 2
+    # 8-aligned window starts: the pileup kernel's accumulator RMW then
+    # hits whole sublane tiles (w0p stays 8-aligned through the clip). The
+    # <=7-lane rightward shift of the band center is absorbed by the 2x
+    # band slack of band_lanes() and is small against the seeder's diag
+    # quantization (quant = band_width // 2 >= 15)
+    win_start = (diag - W // 2) & ~7
     idx = win_start[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
     inb = (idx >= 0) & (idx < L)
     flat_idx = lread[:, None] * L + jnp.clip(idx, 0, L - 1)
@@ -443,7 +449,11 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
     n = m + W
     pad = n
     Lpile = Lp + 2 * n
-    pileup = jnp.zeros((B, Lpile, PACK_LANES), jnp.float32)
+    # the unweighted path's blocked pileup kernel needs a 128-lane buffer
+    # (per-read DMA slices must align to the (1, 128) HBM tiling); the
+    # weighted path's slab kernel streams 64-lane blocks
+    P_buf = PACK_LANES if cns.qual_weighted else 2 * PACK_LANES
+    pileup = jnp.zeros((B, Lpile, P_buf), jnp.float32)
 
     def _dead_chunk():
         """Same pytree as a live chunk, all-dead: lets callers provision
@@ -454,7 +464,8 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
             state=jnp.full((CH, n), -1, jnp.int32), qrow=zi32(CH, n),
             ins_len=zi32(CH, n), score=jnp.full(CH, -1e9, jnp.float32),
             q_start=zi32(CH), q_end=zi32(CH), r_start=zi32(CH),
-            r_end=zi32(CH), valid=jnp.zeros(CH, bool))
+            r_end=zi32(CH), valid=jnp.zeros(CH, bool),
+            ins_b0=zi32(CH, n), ins_b1=zi32(CH, n))
         q = jnp.full((CH, m), 4, jnp.int8)
         qq = jnp.zeros((CH, m), jnp.uint8)
         ign = (None if ignore_flat is None
@@ -511,14 +522,15 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
                     min_aln_length=cns.min_aln_length)
                 return pileup_accumulate(
                     pileup, votes, lread[sl], w0p, interpret=interpret)
-            words = encode_votes(
-                res.state, res.qrow, res.ins_len, q,
+            words = encode_votes_packed_bases(
+                res.state, res.qrow, res.ins_len, res.ins_b0, res.ins_b1,
                 res.q_start, res.q_end, ignore_cols=ign,
                 taboo_frac=taboo_frac, taboo_abs=taboo_abs,
                 min_aln_length=cns.min_aln_length)
             words = jnp.where(keep[:, None], words, 0)
-            return pileup_accumulate_packed(
-                pileup, words, lread[sl], w0p, interpret=interpret)
+            b0, b1 = word_to_bits(words)
+            return pileup_accumulate_bits(
+                pileup, b0, b1, lread[sl], w0p, interpret=interpret)
 
         if c == 0:
             pileup = _vote(pileup)

@@ -229,6 +229,51 @@ class TestVoteParity:
                 np.asarray(getattr(pile_f, name)),
                 np.asarray(getattr(pile_v, name)), err_msg=name)
 
+    def test_bits_votes_vs_fused(self):
+        """The production unweighted path (kernel-packed ins bases ->
+        encode_votes_packed_bases -> word_to_bits -> pileup_accumulate_bits)
+        must be bit-identical to fused_accumulate."""
+        from proovread_tpu.ops.pileup_kernel import pileup_accumulate_bits
+        from proovread_tpu.ops.votes import (encode_votes_packed_bases,
+                                             word_to_bits)
+
+        lr, q, win, qual, qlen, read_idx, w0 = _make_candidates(seed=17)
+        B, L = lr.shape
+        R, n = win.shape
+        # the bits kernel requires 8-aligned window offsets (production
+        # aligns win_start in _gather_and_align); re-cut the windows
+        w0 = (w0 & ~7).astype(np.int32)
+        for i in range(R):
+            win[i] = lr[read_idx[i], w0[i]:w0[i] + n]
+        rb, rs = _bsw_both(q, win, qlen)
+        admitted = np.ones(R, bool)
+        admitted[1::5] = False
+
+        pile_f = pileup_ops.init_pileup(B, L, 6)
+        pile_f = fused_accumulate(
+            pile_f, rs.ops_rev, rs.step_i, rs.step_j,
+            jnp.asarray(q), jnp.asarray(qual), rs.q_start, rs.q_end,
+            jnp.asarray(read_idx), jnp.asarray(w0), jnp.asarray(admitted))
+
+        words = encode_votes_packed_bases(
+            rb.state, rb.qrow, rb.ins_len, rb.ins_b0, rb.ins_b1,
+            rb.q_start, rb.q_end)
+        words = jnp.where(jnp.asarray(admitted)[:, None], words, 0)
+        b0, b1 = word_to_bits(words)
+        pad = n
+        packed = jnp.zeros((B, L + 2 * n, 2 * PACK_LANES), jnp.float32)
+        w0p = jnp.clip(jnp.asarray(w0) + pad, 0, L + 2 * n - n)
+        packed = pileup_accumulate_bits(packed, b0, b1,
+                                        jnp.asarray(read_idx), w0p,
+                                        interpret=True)
+        assert bool((packed[:, :, PACK_LANES:] == 0).all())
+        pile_v = unpack_pileup(packed[:, :, :PACK_LANES], pad, L)
+        for name in ("counts", "ins_mbase", "ins_len_votes",
+                     "ins_base_votes"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(pile_f, name)),
+                np.asarray(getattr(pile_v, name)), err_msg=name)
+
     def test_pileup_accumulate_cross_call(self):
         """Accumulation must compose across calls (input_output_aliases)."""
         rng = np.random.default_rng(4)
