@@ -1,0 +1,249 @@
+"""Scalar-walk Pallas kernels for consensus assembly and HCR masking.
+
+Both jobs are per-read sequential state machines over the column axis —
+exactly the access pattern XLA lowers to its worst path (searchsorted's 13
+gather passes / 6 associative scans at ~10 ns per element, PERF.md). Here
+each read's columns are walked once by the scalar core over SMEM-resident
+rows: all fields of a column are packed into ONE i32 word by cheap
+vectorized XLA ops beforehand, and the kernels' outputs are unpacked the
+same way afterwards, so the kernels never touch wide vectors at all.
+
+Assembly (``assemble_rows``): emitted columns + attached insertions stream
+out to a write cursor — the device twin of
+``consensus/engine.py:assemble_consensus``'s sequence/qual part, replacing
+the searchsorted formulation of the old ``dcorrect.device_assemble``.
+
+HCR masking (``hcr_mask_rows``): the reference's SeqFilter --phred-mask
+run/merge/boundary-reduce semantics (``pipeline/masking.py``) as a one-pass
+interval state machine; the mask comes back bit-packed (32 columns per
+word) and is expanded by reshape+shift, which stays elementwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from proovread_tpu.ops.votes import INS_CAP as INS_K
+
+
+# --------------------------------------------------------------------------
+# consensus assembly
+# --------------------------------------------------------------------------
+#
+# input word layout (i32 per source column):
+#   bit 0      emitted
+#   bits 1-3   base code (0-4)
+#   bits 4-6   emitted insertion length (0-6)
+#   bits 7-12  phred (0-40)
+#   bits 13-30 six 3-bit inserted base codes
+# output word layout: bits 0-2 base code, bits 3-8 phred
+
+
+def _assemble_kernel(len_ref, in_ref, out_ref, nlen_ref, *, Lp):
+    b = pl.program_id(0)
+    L = len_ref[b]
+
+    def body(col, cur):
+        w = in_ref[0, 0, col]
+        em = (w & 1) == 1
+        nins = (w >> 4) & 7
+        phred = (w >> 7) & 63
+
+        @pl.when(em & (cur < Lp))
+        def _():
+            out_ref[0, 0, cur] = ((w >> 1) & 7) | (phred << 3)
+
+        for k in range(INS_K):
+            @pl.when(em & (k < nins) & (cur + 1 + k < Lp))
+            def _():
+                out_ref[0, 0, cur + 1 + k] = \
+                    ((w >> (13 + 3 * k)) & 7) | (phred << 3)
+
+        return cur + jnp.where(em, 1 + nins, 0)
+
+    cur = jax.lax.fori_loop(0, L, body, jnp.int32(0))
+    nlen_ref[0, b] = jnp.minimum(cur, Lp)
+
+
+@functools.partial(jax.jit, static_argnames=("Lp", "interpret"))
+def assemble_rows(call, lengths, Lp: int, interpret: bool = False):
+    """Packed scalar-walk replacement for the searchsorted device_assemble:
+    same contract — (new codes i8 [B, Lp], new qual u8 [B, Lp], new lengths).
+    Output longer than Lp is truncated (the pad carries slack)."""
+    B, L = call.base.shape
+    valid_col = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
+    em = (valid_col & call.emitted).astype(jnp.int32)
+    word = em
+    word |= jnp.clip(call.base.astype(jnp.int32), 0, 7) << 1
+    word |= jnp.clip(call.ins_len, 0, INS_K) << 4
+    word |= jnp.clip(call.phred.astype(jnp.int32), 0, 63) << 7
+    ib = jnp.clip(call.ins_bases.astype(jnp.int32), 0, 7)      # [B, L, K]
+    for k in range(INS_K):
+        word |= ib[:, :, k] << (13 + 3 * k)
+
+    # middle singletons so the TPU block-shape rule sees the block's last
+    # two dims equal to the array's; the scalar nlen row is a (1, B) block
+    # shared by every program (each writes its own element)
+    out_w3, nlen2 = pl.pallas_call(
+        functools.partial(_assemble_kernel, Lp=Lp),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B,),
+            in_specs=[pl.BlockSpec((1, 1, L), lambda b, ln: (b, 0, 0),
+                                   memory_space=pltpu.SMEM)],
+            out_specs=[pl.BlockSpec((1, 1, Lp), lambda b, ln: (b, 0, 0),
+                                    memory_space=pltpu.SMEM),
+                       pl.BlockSpec((1, B), lambda b, ln: (0, 0),
+                                    memory_space=pltpu.SMEM)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B, 1, Lp), jnp.int32),
+                   jax.ShapeDtypeStruct((1, B), jnp.int32)],
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), word.reshape(B, 1, L))
+    out_w = out_w3.reshape(B, Lp)
+    nlen = nlen2.reshape(B)
+
+    live = jnp.arange(Lp, dtype=jnp.int32)[None, :] < nlen[:, None]
+    new_codes = jnp.where(live, out_w & 7, 4).astype(jnp.int8)
+    new_qual = jnp.where(live, (out_w >> 3) & 63, 0).astype(jnp.uint8)
+    return new_codes, new_qual, nlen
+
+
+# --------------------------------------------------------------------------
+# HCR masking
+# --------------------------------------------------------------------------
+
+
+def _hcr_kernel(len_ref, pv_ref, q_ref, bits_ref, count_ref, *, Lp):
+    b = pl.program_id(0)
+    L = len_ref[b]
+    pmin = pv_ref[0]
+    pmax = pv_ref[1]
+    min_len = pv_ref[2]
+    unmask_len = pv_ref[3]
+    red = pv_ref[4]
+    end_red = pv_ref[5]
+
+    nw = (Lp + 31) // 32
+
+    def zero(i, _):
+        bits_ref[0, 0, i] = 0
+        return 0
+
+    jax.lax.fori_loop(0, nw, zero, 0)
+    count_ref[0, b] = 0
+
+    def emit_run(ms, me):
+        """Write the boundary-reduced merged run [ms, me) as mask bits."""
+        lo = ms + jnp.where(ms == 0, end_red, red)
+        hi = me - jnp.where(me == L, end_red, red)
+        lo = jnp.maximum(lo, 0)
+        hi = jnp.minimum(hi, L)
+
+        @pl.when(hi > lo)
+        def _():
+            count_ref[0, b] = count_ref[0, b] + (hi - lo)
+            wlo, whi = lo >> 5, (hi - 1) >> 5
+            first = jnp.int32(-1) << (lo & 31)
+            # (hi & 31) == 0 means the last word is fully covered
+            last = ~jnp.where((hi & 31) == 0, 0,
+                              jnp.int32(-1) << (hi & 31))
+
+            def word(i, _):
+                m = jnp.where(i == wlo, first, jnp.int32(-1)) \
+                    & jnp.where(i == whi, last, jnp.int32(-1))
+                bits_ref[0, 0, i] = bits_ref[0, 0, i] | m
+                return 0
+
+            jax.lax.fori_loop(wlo, whi + 1, word, 0)
+
+    # state: (in_run_start, kept_start, kept_end) of the growing merged run;
+    # kept_start < 0 = no merged run pending
+    def body(col, st):
+        run_s, ms, me = st
+        q = q_ref[0, 0, col]
+        inq = (q >= pmin) & (q <= pmax)
+        # close an inq run at the first out-of-range column
+        run_end = (~inq) & (run_s >= 0)
+        qual_run = run_end & ((col - run_s) >= min_len)
+        # a qualifying kept run either extends the pending merged run
+        # (gap < unmask_len) or flushes it and starts a new one
+        extend = qual_run & (ms >= 0) & ((run_s - me) < unmask_len)
+        flush = qual_run & (ms >= 0) & ~extend
+
+        @pl.when(flush)
+        def _():
+            emit_run(ms, me)
+
+        ms = jnp.where(qual_run, jnp.where(extend, ms, run_s), ms)
+        me = jnp.where(qual_run, col, me)
+        run_s = jnp.where(inq, jnp.where(run_s < 0, col, run_s),
+                          jnp.int32(-1))
+        return run_s, ms, me
+
+    st = (jnp.int32(-1), jnp.int32(-1), jnp.int32(-1))
+    run_s, ms, me = jax.lax.fori_loop(0, L, body, st)
+    # a run reaching the read end closes at L
+    qual_run = (run_s >= 0) & ((L - run_s) >= min_len)
+    extend = qual_run & (ms >= 0) & ((run_s - me) < unmask_len)
+    flush = qual_run & (ms >= 0) & ~extend
+
+    @pl.when(flush)
+    def _():
+        emit_run(ms, me)
+
+    ms = jnp.where(qual_run, jnp.where(extend, ms, run_s), ms)
+    me = jnp.where(qual_run, L, me)
+
+    @pl.when(ms >= 0)
+    def _():
+        emit_run(ms, me)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def hcr_mask_rows(qual, lengths, pv, interpret: bool = False):
+    """Scalar-walk twin of ``dcorrect.device_hcr_mask_dyn``: same params
+    vector (``mask_params_vec``), same (mask bool [B, L], masked frac)."""
+    B, L = qual.shape
+    Lp = -(-L // 32) * 32
+    nw = Lp // 32
+    q32 = qual.astype(jnp.int32)
+    # integer param vector (scalar-prefetch args are int32): the end_red
+    # rounding happens here, not in the kernel
+    pvf = pv.astype(jnp.float32)
+    pvi = jnp.concatenate([
+        pvf[:5].astype(jnp.int32),
+        jnp.round(pvf[4] * pvf[5]).astype(jnp.int32)[None],
+    ])
+
+    bits3, counts2 = pl.pallas_call(
+        functools.partial(_hcr_kernel, Lp=Lp),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[pl.BlockSpec((1, 1, L), lambda b, ln, pv: (b, 0, 0),
+                                   memory_space=pltpu.SMEM)],
+            out_specs=[pl.BlockSpec((1, 1, nw), lambda b, ln, pv: (b, 0, 0),
+                                    memory_space=pltpu.SMEM),
+                       pl.BlockSpec((1, B), lambda b, ln, pv: (0, 0),
+                                    memory_space=pltpu.SMEM)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B, 1, nw), jnp.int32),
+                   jax.ShapeDtypeStruct((1, B), jnp.int32)],
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), pvi, q32.reshape(B, 1, L))
+    bits = bits3.reshape(B, nw)
+    counts = counts2.reshape(B)
+
+    # bit j of word w -> column 32w + j: broadcast + shift stays elementwise
+    expanded = jnp.broadcast_to(bits[:, :, None], (B, nw, 32))
+    sh = jnp.arange(32, dtype=jnp.int32)[None, None, :]
+    mask = (((expanded >> sh) & 1) > 0).reshape(B, Lp)[:, :L]
+    total = jnp.maximum(jnp.sum(lengths), 1)
+    frac = jnp.sum(counts) / total
+    return mask, frac

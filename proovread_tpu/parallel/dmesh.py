@@ -90,7 +90,7 @@ def sharded_iteration_step(
             interpret=itp, collect=False)
 
         new_codes, new_qual, new_len = device_assemble(
-            call, qual, lengths, Lp)
+            call, lengths, Lp, interpret=itp)
         new_mask, _ = device_hcr_mask(new_qual, new_len, mask_params)
 
         masked = jax.lax.psum(jnp.sum(new_mask), "dp")

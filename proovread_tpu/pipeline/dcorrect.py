@@ -122,12 +122,24 @@ def device_admit(
     return jnp.zeros(R, bool).at[order].set(admit)
 
 
-@functools.partial(jax.jit, static_argnames=("Lp",))
-def device_assemble(call: ConsensusCall, ref_qual: jnp.ndarray,
-                    lengths: jnp.ndarray, Lp: int):
+def device_assemble(call: ConsensusCall, lengths: jnp.ndarray, Lp: int,
+                    interpret: Optional[bool] = None):
     """On-device twin of consensus/engine.py:assemble_consensus (sequence
-    part): emitted columns + inserted bases -> new packed codes/qual/lengths.
-    Output longer than Lp is truncated (the pad carries slack)."""
+    part): emitted columns + inserted bases -> new packed codes/qual/lengths,
+    via the scalar-walk Pallas kernel (ops/assemble_kernel.py)."""
+    from proovread_tpu.ops.assemble_kernel import assemble_rows
+
+    if interpret is None:
+        interpret = bsw.default_interpret()
+    return assemble_rows(call, lengths, Lp, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("Lp",))
+def device_assemble_xla(call: ConsensusCall, ref_qual: jnp.ndarray,
+                        lengths: jnp.ndarray, Lp: int):
+    """searchsorted reference formulation of :func:`device_assemble` —
+    kept as the equivalence oracle for the kernel (13 sequential gather
+    passes made it the slowest op of the fused pass, PERF.md)."""
     B, L = call.base.shape
     valid_col = jnp.arange(L, dtype=jnp.int32)[None, :] < lengths[:, None]
     emit_counts = jnp.where(valid_col & call.emitted, 1 + call.ins_len, 0)
@@ -167,12 +179,23 @@ def mask_params_vec(p: MaskParams) -> jnp.ndarray:
                        jnp.float32)
 
 
-@jax.jit
 def device_hcr_mask_dyn(qual: jnp.ndarray, lengths: jnp.ndarray,
-                        pv: jnp.ndarray):
+                        pv: jnp.ndarray, interpret: Optional[bool] = None):
     """On-device twin of pipeline/masking.py:hcr_intervals/mask_batch with
-    the 6 mask params passed as data (``mask_params_vec``).
-    Returns (mask bool [B, L], masked_frac scalar)."""
+    the 6 mask params passed as data (``mask_params_vec``), via the
+    scalar-walk Pallas kernel. Returns (mask bool [B, L], masked frac)."""
+    from proovread_tpu.ops.assemble_kernel import hcr_mask_rows
+
+    if interpret is None:
+        interpret = bsw.default_interpret()
+    return hcr_mask_rows(qual, lengths, pv, interpret=interpret)
+
+
+@jax.jit
+def device_hcr_mask_dyn_xla(qual: jnp.ndarray, lengths: jnp.ndarray,
+                            pv: jnp.ndarray):
+    """associative-scan reference formulation of
+    :func:`device_hcr_mask_dyn` — kept as the kernel's equivalence oracle."""
     phred_min = pv[0].astype(jnp.int32)
     phred_max = pv[1].astype(jnp.int32)
     mask_min_len = pv[2].astype(jnp.int32)
@@ -626,9 +649,10 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
             m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
             interpret=interpret, collect=False)
         new_codes, new_qual, new_len = device_assemble(
-            call, qual, lengths, Lp)
+            call, lengths, Lp, interpret=interpret)
         new_mask, frac = device_hcr_mask_dyn(new_qual, new_len,
-                                             mask_pvs[it])
+                                             mask_pvs[it],
+                                             interpret=interpret)
         return new_codes, new_qual, new_len, new_mask, frac, n_cand, n_adm
 
     def cond(state):

@@ -265,7 +265,7 @@ class Pipeline:
             call, stats = dc.correct_pass(
                 codes, qual, lengths, None, qc, rcq, qq, qlen, ap1, cns,
                 seed_stride=cfg.seed_stride)
-            codes, qual, lengths = device_assemble(call, qual, lengths, Lp)
+            codes, qual, lengths = device_assemble(call, lengths, Lp)
             mask_cols, frac = device_hcr_mask(qual, lengths, _mask_p(1))
             new_frac, n_adm, n_c = jax.device_get(
                 (frac, stats.n_admitted, stats.n_candidates))

@@ -466,8 +466,7 @@ class TestDeviceCorrectorE2E:
         assert stats.n_admitted > 0
 
         codes2, qual2, len2 = device_assemble(
-            call, jnp.asarray(lr.qual), jnp.asarray(lr.lengths),
-            lr.codes.shape[1])
+            call, jnp.asarray(lr.lengths), lr.codes.shape[1])
         codes2 = np.asarray(codes2)
         len2 = np.asarray(len2)
 
@@ -552,14 +551,14 @@ class TestFusedIterations:
         for _ in range(2):
             call, _ = dc.correct_pass(c1, q1, l1, mask1, qc, rcq, qq, qlen,
                                       ap, cns)
-            c1, q1, l1 = device_assemble(call, q1, l1, Lp)
+            c1, q1, l1 = device_assemble(call, l1, Lp)
             mask1, frac1 = device_hcr_mask(q1, l1, mp)
 
         # fused: pass 1 eager, pass 2 inside fused_iterations
         c2, q2, l2 = codes, qual, lengths
         call, _ = dc.correct_pass(c2, q2, l2, None, qc, rcq, qq, qlen,
                                   ap, cns)
-        c2, q2, l2 = device_assemble(call, q2, l2, Lp)
+        c2, q2, l2 = device_assemble(call, l2, Lp)
         mask2, frac_a = device_hcr_mask(q2, l2, mp)
         sels = np.arange(len(sr.lengths), dtype=np.int32)[None, :]
         pvs = np.asarray(mask_params_vec(mp))[None, :]
@@ -579,3 +578,69 @@ class TestFusedIterations:
         np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
         np.testing.assert_array_equal(np.asarray(mask1), np.asarray(mask2))
         assert float(fracs[0]) == pytest.approx(float(frac1), abs=1e-6)
+
+
+class TestScalarWalkKernels:
+    """The scalar-walk Pallas kernels (ops/assemble_kernel.py) vs their
+    XLA oracle formulations kept in dcorrect."""
+
+    def _call(self, rng, B, L, K=6):
+        from proovread_tpu.ops.consensus_call import ConsensusCall
+        emitted = rng.random((B, L)) > 0.15
+        return ConsensusCall(
+            emitted=jnp.asarray(emitted),
+            base=jnp.asarray(rng.integers(0, 5, (B, L)).astype(np.int8)),
+            ins_len=jnp.asarray(np.where(
+                rng.random((B, L)) < 0.08,
+                rng.integers(1, K + 1, (B, L)), 0).astype(np.int32)),
+            ins_bases=jnp.asarray(
+                rng.integers(0, 5, (B, L, K)).astype(np.int8)),
+            freq=jnp.asarray(rng.random((B, L)).astype(np.float32)),
+            phred=jnp.asarray(rng.integers(0, 41, (B, L)).astype(np.int32)),
+            coverage=jnp.asarray(rng.random((B, L)).astype(np.float32)))
+
+    def test_assemble_vs_oracle(self):
+        from proovread_tpu.pipeline.dcorrect import (device_assemble,
+                                                     device_assemble_xla)
+        rng = np.random.default_rng(23)
+        B, L, Lp = 7, 300, 320
+        for trial in range(3):
+            call = self._call(rng, B, L)
+            lengths = jnp.asarray(
+                rng.integers(0, L + 1, B).astype(np.int32))
+            qual = jnp.asarray(rng.integers(0, 41, (B, L)).astype(np.uint8))
+            ref = device_assemble_xla(call, qual, lengths, Lp)
+            got = device_assemble(call, lengths, Lp, interpret=True)
+            for a, b, name in zip(ref, got, ("codes", "qual", "len")):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                              err_msg=f"trial {trial} {name}")
+
+    def test_hcr_mask_vs_oracle(self):
+        from proovread_tpu.pipeline.dcorrect import (
+            device_hcr_mask_dyn, device_hcr_mask_dyn_xla, mask_params_vec)
+        from proovread_tpu.pipeline.masking import MaskParams
+        rng = np.random.default_rng(29)
+        B, L = 9, 640
+        for mp in (MaskParams().scaled(100),
+                   MaskParams(end_ratio=0.3).scaled(100),
+                   MaskParams(mask_min_len=10, unmask_min_len=20,
+                              mask_reduce=3, end_ratio=0.5)):
+            qual = np.zeros((B, L), np.uint8)
+            lengths = rng.integers(50, L + 1, B).astype(np.int32)
+            for b in range(B):
+                pos = 0
+                hi = bool(rng.integers(0, 2))
+                while pos < lengths[b]:
+                    seg = int(rng.integers(3, 180))
+                    qual[b, pos:pos + seg] = (rng.integers(25, 41) if hi
+                                              else rng.integers(0, 10))
+                    pos += seg
+                    hi = not hi
+            pv = mask_params_vec(mp)
+            m1, f1 = device_hcr_mask_dyn_xla(jnp.asarray(qual),
+                                             jnp.asarray(lengths), pv)
+            m2, f2 = device_hcr_mask_dyn(jnp.asarray(qual),
+                                         jnp.asarray(lengths), pv,
+                                         interpret=True)
+            np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+            assert abs(float(f1) - float(f2)) < 1e-6

@@ -72,7 +72,7 @@ class TestShardedStep:
         dc = DeviceCorrector(chunk=1024)
         call, stats = dc.correct_pass(
             codes, qual, lengths, None, qc, rcq, qq, qlen, ap, cns)
-        c1, q1, l1 = device_assemble(call, qual, lengths, Lp)
+        c1, q1, l1 = device_assemble(call, lengths, Lp)
         m1, frac1 = device_hcr_mask(q1, l1, mp)
 
         mesh = make_dp_mesh(n_dev)
