@@ -49,16 +49,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="input short-read coverage estimate")
     ap.add_argument("-t", "--threads", type=int, default=1,
                     help="accepted for interface parity; parallelism comes "
-                         "from the device mesh")
+                         "from the device mesh (a warning is logged when "
+                         "a value > 1 is given)")
     ap.add_argument("--lr-min-length", type=int,
                     help="min long-read length (0 disables; default 2x "
                          "median short-read length)")
     ap.add_argument("--ignore-sr-length", action="store_true",
                     help="accept short reads longer than 1000bp "
                          "(bin/proovread:457-464 guard)")
-    ap.add_argument("--haplo-coverage", type=float,
-                    help="per-read coverage cutoff for uneven-coverage "
-                         "data (proovread-flex role; sam/bam modes)")
+    ap.add_argument("--haplo-coverage", type=float, nargs="?",
+                    const=-1.0,
+                    help="flex mode (proovread-flex role): bare flag = "
+                         "estimate each read's own-haplotype coverage on "
+                         "device and tighten admission; a float value = "
+                         "explicit per-read coverage cutoff (sam/bam "
+                         "re-entry modes)")
     ap.add_argument("--no-sampling", action="store_true",
                     help="use all short reads every iteration")
     ap.add_argument("--overwrite", action="store_true",
@@ -101,6 +106,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         format="[%(asctime)s] %(message)s", datefmt="%H:%M:%S")
 
     from proovread_tpu.config import Config, mode_auto
+
+    if args.threads and args.threads > 1:
+        log.warning("-t/--threads %d is accepted for interface parity but "
+                    "has no effect: parallelism comes from the device mesh "
+                    "(one XLA program per chip)", args.threads)
 
     if args.create_cfg:
         Config.create_template(args.create_cfg)

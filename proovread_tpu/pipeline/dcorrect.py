@@ -86,9 +86,12 @@ def device_admit(
     passed: jnp.ndarray,    # bool [R] threshold + validity
     ref_lens: jnp.ndarray,  # i32 [B]
     params: ConsensusParams,
+    budget_r: Optional[jnp.ndarray] = None,  # f32 [B] per-read bin budget
 ) -> jnp.ndarray:
     """jnp twin of consensus/alnset.py:admit_mask (same sort keys, same
-    crossing-alignment admission rule)."""
+    crossing-alignment admission rule). ``budget_r`` overrides the global
+    ``bin_max_bases`` per read — the flex mode's filter_by_coverage
+    (Sam/Seq.pm:1059-1084) expressed directly in the admission budget."""
     R = lread.shape[0]
     keep = passed & (span > 0)
     eff = -score if params.invert_scores else score
@@ -118,8 +121,50 @@ def device_admit(
     first = jnp.searchsorted(sbins, sbins, side="left")
     before = jnp.where(first > 0, cum[jnp.maximum(first - 1, 0)], 0.0)
     cum_before = cum - sspans - before
-    admit = keep[order] & (cum_before <= params.bin_max_bases)
+    if budget_r is None:
+        budget = jnp.float32(params.bin_max_bases)
+    else:
+        budget = jnp.minimum(
+            budget_r[jnp.clip(lread, 0, None)],
+            jnp.float32(params.bin_max_bases))[order]
+    admit = keep[order] & (cum_before <= budget)
     return jnp.zeros(R, bool).at[order].set(admit)
+
+
+@jax.jit
+def estimate_haplo_coverage(plain_counts, coverage, ref_codes, lengths):
+    """``Sam::Seq::haplo_coverage`` (Sam/Seq.pm:1136-1172) on the pileup
+    tensors: variant columns are those with >= 2 single-base states at
+    freq >= 4 (call_variants' min_freq); of each, take the freq of the
+    state agreeing with the (long-read) reference base; the estimate is
+    the 75th percentile of those. It is significant — the read really has
+    an under-represented haplotype — when (#variant cols / #cols with
+    coverage >= 1.5x estimate) > 0.00015.
+
+    Returns f32 [B]: estimated own-haplotype coverage, +inf when no
+    significant estimate (no tightening)."""
+    B, L, S = plain_counts.shape
+    base_counts = plain_counts[:, :, :4]                   # A, C, G, T
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    valid = pos < lengths[:, None]
+    n_qual = (base_counts >= 4.0).sum(-1)
+    rc = jnp.clip(ref_codes, 0, 3).astype(jnp.int32)
+    fc = (base_counts
+          * (jnp.arange(4, dtype=jnp.int32)[None, None, :]
+             == rc[:, :, None])).sum(-1)
+    sel = valid & (n_qual >= 2) & (ref_codes < 4) & (fc >= 4.0)
+
+    INF = jnp.float32(jnp.inf)
+    vals = jnp.where(sel, fc, INF)
+    svals = jnp.sort(vals, axis=1)
+    n_sel = sel.sum(1)
+    q_idx = jnp.where(n_sel > 0, ((n_sel - 1) * 3) // 4, 0)
+    hpl = jnp.take_along_axis(svals, q_idx[:, None], axis=1)[:, 0]
+
+    high = (valid & (coverage >= 1.5 * hpl[:, None])).sum(1)
+    df = n_sel / jnp.maximum(high, 1)
+    ok = (n_sel > 0) & jnp.where(high > 0, df > 0.00015, False)
+    return jnp.where(ok, hpl, INF)
 
 
 def device_assemble(call: ConsensusCall, lengths: jnp.ndarray, Lp: int,
@@ -411,21 +456,27 @@ def detect_chimera_device(results, ref_lens: np.ndarray, aln: AlnData) -> None:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("m", "W", "interpret", "ap"),
+    static_argnames=("m", "W", "interpret", "ap", "need_qual"),
 )
 def _gather_and_align(map_flat, q_codes, rc_codes, q_qual, q_lengths,
                       sread, strand, lread, diag, L,
                       m: int, W: int, ap: AlignParams,
-                      ignore_flat=None, interpret: bool = False):
+                      ignore_flat=None, interpret: bool = False,
+                      need_qual: bool = True):
     """One chunk: gather query/window slabs, run the bsw kernel, build the
-    (pre-admission) vote slabs and per-candidate stats."""
+    (pre-admission) vote slabs and per-candidate stats. ``need_qual=False``
+    skips the query-qual gathers (the unweighted vote path never reads
+    them, and each row gather runs at scalar-core speed)."""
     n = m + W
     R = sread.shape[0]
 
     q = jnp.where(strand[:, None] == 0, q_codes[sread], rc_codes[sread])
-    qual_f = q_qual[sread]
-    qual_r = device_reverse_rows(qual_f, q_lengths[sread])
-    qual = jnp.where(strand[:, None] == 0, qual_f, qual_r)
+    if need_qual:
+        qual_f = q_qual[sread]
+        qual_r = device_reverse_rows(qual_f, q_lengths[sread])
+        qual = jnp.where(strand[:, None] == 0, qual_f, qual_r)
+    else:
+        qual = None
     qlen = q_lengths[sread]
 
     # 8-aligned window starts: the pileup kernel's accumulator RMW then
@@ -455,13 +506,16 @@ def _gather_and_align(map_flat, q_codes, rc_codes, q_qual, q_lengths,
     return res, q, qual, win_start, passed, pos0, span, ignore_cols
 
 
-def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
-                     q_codes, rc_codes, q_qual, q_lengths,
-                     sread, strand, lread, diag, n_cand,
-                     m: int, W: int, CH: int, n_chunks: int,
-                     ap: AlignParams, cns: ConsensusParams,
-                     interpret: bool, collect: bool):
-    """One full correction pass as a SINGLE XLA program.
+def _fused_pass_unrolled(map_flat, ignore_flat, codes, qual, lengths,
+                         q_codes, rc_codes, q_qual, q_lengths,
+                         sread, strand, lread, diag, n_cand,
+                         m: int, W: int, CH: int, n_chunks: int,
+                         ap: AlignParams, cns: ConsensusParams,
+                         interpret: bool, collect: bool,
+                         budget_r=None, haplo: bool = False):
+    """Python-unrolled chunk loop (qual-weighted path only — the unrolled
+    program grows with n_chunks and its compile time explodes past ~16
+    chunks; the mainline unweighted path is :func:`_fused_pass_scanned`).
 
     The sub-ops (bsw kernel, vote packing, pileup scatter, consensus call)
     each run in well under a millisecond on the chip; dispatched one by one
@@ -524,7 +578,7 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
     R_tot = all_passed.shape[0]
     admitted = device_admit(
         lread[:R_tot], all_pos0, all_span, all_score, all_passed,
-        lengths, cns)
+        lengths, cns, budget_r=budget_r)
 
     taboo_frac = cns.indel_taboo if cns.trim else 0.0
     taboo_abs = (cns.indel_taboo_length or 0) if cns.trim else 0
@@ -563,6 +617,14 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
                 _vote, lambda p: p, pileup)
 
     pile = unpack_pileup(pileup, pad, Lp)
+    hpl = None
+    if haplo:
+        # flex mode: estimate the read's own-haplotype coverage from the
+        # pre-ref-vote pileup; the driver tightens the NEXT pass's
+        # admission budget with it (Sam/Seq.pm:666-701 semantics folded
+        # into the iteration loop)
+        hpl = estimate_haplo_coverage(
+            pile.counts - pile.ins_mbase, pile.coverage, codes, lengths)
     if cns.use_ref_qual:
         pos = jnp.arange(Lp, dtype=jnp.int32)[None, :]
         lmask = (pos < lengths[:, None]).astype(jnp.float32)
@@ -571,7 +633,7 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
     call = call_consensus(pile, codes, cns.max_ins_length)
     n_admitted = admitted.sum()
     if not collect:
-        return call, n_admitted, None, None
+        return call, n_admitted, None, None, hpl
     scalars = (
         lread[:R_tot], all_pos0, all_span, admitted,
         jnp.concatenate([c[0].q_start for c in chunks]),
@@ -583,13 +645,154 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
     slabs = ([c[0].state for c in chunks],
              [c[0].qrow for c in chunks],
              [c[0].ins_len for c in chunks])
-    return call, n_admitted, scalars, slabs
+    return call, n_admitted, scalars, slabs, hpl
+
+
+def _fused_pass_scanned(map_flat, ignore_flat, codes, qual, lengths,
+                        q_codes, rc_codes, q_qual, q_lengths,
+                        sread, strand, lread, diag, n_cand,
+                        m: int, W: int, CH: int, n_chunks: int,
+                        ap: AlignParams, cns: ConsensusParams,
+                        interpret: bool, collect: bool,
+                        budget_r=None, haplo: bool = False):
+    """One full correction pass with the chunk loop as ``lax.scan``.
+
+    The unrolled formulation duplicated the whole align+vote body per chunk
+    in the XLA program: at small scale (<= 6 chunks) that was fine, but the
+    scaled workloads need 50-100+ chunks and the compile time exploded to
+    tens of minutes. Here the program contains ONE chunk body regardless of
+    n_chunks: scan 1 aligns each chunk and stacks compact slabs (state i8,
+    qrow/ins_len i16, packed ins-base words) in HBM, admission runs
+    globally over the stacked stats, and scan 2 encodes votes and feeds the
+    blocked pileup kernel with the pileup buffer as the scan carry."""
+    B, Lp = codes.shape
+    n = m + W
+    pad = n
+    Lpile = Lp + 2 * n
+    nc = n_chunks
+    taboo_frac = cns.indel_taboo if cns.trim else 0.0
+    taboo_abs = (cns.indel_taboo_length or 0) if cns.trim else 0
+
+    def r2(x):
+        return x.reshape(nc, CH)
+
+    xs = (jnp.arange(nc, dtype=jnp.int32), r2(sread),
+          r2(strand.astype(jnp.int32)), r2(lread), r2(diag))
+
+    def align_one(c, sread_c, strand_c, lread_c, diag_c):
+        def live():
+            res, q, _, win_start, passed, pos0, span, ign = \
+                _gather_and_align(
+                    map_flat, q_codes, rc_codes, q_qual, q_lengths,
+                    sread_c, strand_c, lread_c, diag_c, Lp, m=m, W=W,
+                    ap=ap, ignore_flat=ignore_flat, interpret=interpret,
+                    need_qual=False)
+            live_m = (c * CH + jnp.arange(CH, dtype=jnp.int32)) < n_cand
+            state = res.state
+            ins_len = res.ins_len
+            if ign is not None:
+                # masked ref columns are gated from all votes (col_ok in
+                # the encoder); killing the state and the attached run
+                # here reproduces that without storing the mask
+                state = jnp.where(ign, -1, state)
+                ins_len = jnp.where(ign, 0, ins_len)
+            return (state.astype(jnp.int8), res.qrow.astype(jnp.int16),
+                    ins_len.astype(jnp.int16), res.ins_b0, res.ins_b1,
+                    res.q_start, res.q_end, res.r_start, res.r_end,
+                    win_start, passed & live_m, pos0, span, res.score)
+
+        def dead():
+            def zi(*shape):
+                return jnp.zeros(shape, jnp.int32)
+            return (jnp.full((CH, n), -1, jnp.int8),
+                    jnp.zeros((CH, n), jnp.int16),
+                    jnp.zeros((CH, n), jnp.int16), zi(CH, n), zi(CH, n),
+                    zi(CH), zi(CH), zi(CH), zi(CH), zi(CH),
+                    jnp.zeros(CH, bool), zi(CH), zi(CH),
+                    jnp.full(CH, -1e9, jnp.float32))
+
+        return jax.lax.cond(c * CH < n_cand, live, dead)
+
+    def scan_align(carry, x):
+        return carry, align_one(*x)
+
+    _, ys = jax.lax.scan(scan_align, 0, xs)
+    (st_s, qr_s, il_s, b0_s, b1_s, qs_s, qe_s, rs_s, re_s, ws_s,
+     passed_s, pos0_s, span_s, score_s) = ys
+
+    def flat(a):
+        return a.reshape(nc * CH, *a.shape[2:])
+
+    admitted = device_admit(
+        lread, flat(pos0_s), flat(span_s), flat(score_s), flat(passed_s),
+        lengths, cns, budget_r=budget_r)
+    adm_s = admitted.reshape(nc, CH)
+
+    pileup0 = jnp.zeros((B, Lpile, 2 * PACK_LANES), jnp.float32)
+
+    def scan_vote(pileup, x):
+        (st_c, qr_c, il_c, b0_c, b1_c, qs_c, qe_c, ws_c, adm_c,
+         lread_c) = x
+        words = encode_votes_packed_bases(
+            st_c.astype(jnp.int32), qr_c.astype(jnp.int32),
+            il_c.astype(jnp.int32), b0_c, b1_c, qs_c, qe_c,
+            taboo_frac=taboo_frac, taboo_abs=taboo_abs,
+            min_aln_length=cns.min_aln_length)
+        words = jnp.where(adm_c[:, None], words, 0)
+        b0, b1 = word_to_bits(words)
+        w0p = jnp.clip(ws_c + pad, 0, Lpile - n)
+        return pileup_accumulate_bits(pileup, b0, b1, lread_c, w0p,
+                                      interpret=interpret), None
+
+    pileup, _ = jax.lax.scan(
+        scan_vote, pileup0,
+        (st_s, qr_s, il_s, b0_s, b1_s, qs_s, qe_s, ws_s, adm_s,
+         r2(lread)))
+
+    pile = unpack_pileup(pileup, pad, Lp)
+    hpl = None
+    if haplo:
+        hpl = estimate_haplo_coverage(
+            pile.counts - pile.ins_mbase, pile.coverage, codes, lengths)
+    if cns.use_ref_qual:
+        pos = jnp.arange(Lp, dtype=jnp.int32)[None, :]
+        lmask = (pos < lengths[:, None]).astype(jnp.float32)
+        pile = add_ref_votes(pile, codes, qual.astype(jnp.float32), lmask)
+
+    call = call_consensus(pile, codes, cns.max_ins_length)
+    n_admitted = admitted.sum()
+    if not collect:
+        return call, n_admitted, None, None, hpl
+    scalars = (lread, flat(pos0_s), flat(span_s), admitted, flat(qs_s),
+               flat(qe_s), flat(ws_s), flat(rs_s), flat(re_s))
+    slabs = (st_s, qr_s, il_s)
+    return call, n_admitted, scalars, slabs, hpl
+
+
+def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
+                     q_codes, rc_codes, q_qual, q_lengths,
+                     sread, strand, lread, diag, n_cand,
+                     m: int, W: int, CH: int, n_chunks: int,
+                     ap: AlignParams, cns: ConsensusParams,
+                     interpret: bool, collect: bool,
+                     budget_r=None, haplo: bool = False):
+    """One full correction pass as a SINGLE XLA program: the scanned chunk
+    loop for the mainline unweighted path, the unrolled formulation for
+    the qual-weighted one (build_votes needs the query slabs in flight)."""
+    impl = (_fused_pass_unrolled if cns.qual_weighted
+            else _fused_pass_scanned)
+    return impl(map_flat, ignore_flat, codes, qual, lengths,
+                q_codes, rc_codes, q_qual, q_lengths,
+                sread, strand, lread, diag, n_cand,
+                m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
+                interpret=interpret, collect=collect,
+                budget_r=budget_r, haplo=haplo)
 
 
 _fused_pass = functools.partial(
     jax.jit,
     static_argnames=("m", "W", "CH", "n_chunks", "ap", "cns", "interpret",
-                     "collect"),
+                     "collect", "haplo"),
 )(_fused_pass_body)
 
 
@@ -597,7 +800,7 @@ _fused_pass = functools.partial(
     jax.jit,
     static_argnames=("m", "W", "CH", "n_chunks", "ap", "cns", "interpret",
                      "n_rest", "Lp", "seed_stride", "seed_min_votes",
-                     "shortcut_frac", "min_gain"),
+                     "shortcut_frac", "min_gain", "full_set"),
 )
 def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
                      sr_codes, sr_rc, sr_qual, sr_lengths,
@@ -606,7 +809,8 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
                      ap: AlignParams, cns: ConsensusParams,
                      interpret: bool, n_rest: int, Lp: int,
                      seed_stride: int, seed_min_votes: int,
-                     shortcut_frac: float, min_gain: float):
+                     shortcut_frac: float, min_gain: float,
+                     full_set: bool = False):
     """Iterations 2..N as ONE device program (``lax.while_loop``).
 
     The host loop pays one blocking round trip per pass on the tunneled
@@ -624,11 +828,16 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
     B = codes.shape[0]
 
     def one_pass(codes, qual, lengths, mask_cols, it):
-        sel = sels[it]
-        qc = sr_codes[sel]
-        rcq = sr_rc[sel]
-        qq = sr_qual[sel]
-        qlen = sr_lengths[sel]
+        if full_set:
+            # sampling off: every pass uses the whole query set — the row
+            # gather would be an identity permutation at scalar-core speed
+            qc, rcq, qq, qlen = sr_codes, sr_rc, sr_qual, sr_lengths
+        else:
+            sel = sels[it]
+            qc = sr_codes[sel]
+            rcq = sr_rc[sel]
+            qq = sr_qual[sel]
+            qlen = sr_lengths[sel]
 
         map_codes = jnp.where(mask_cols, jnp.int8(N), codes)
         index = dseed.device_index(map_codes, lengths, ap.min_seed_len)
@@ -642,7 +851,7 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
             sread, strand, lread, diag, R_need)
         n_cand = jnp.minimum(n_valid, R_need).astype(jnp.int32)
 
-        call, n_adm, _, _ = _fused_pass_body(
+        call, n_adm, _, _, _ = _fused_pass_body(
             map_codes.reshape(-1), mask_cols.reshape(-1),
             codes, qual, lengths, qc, rcq, qq, qlen,
             sread, strand, lread, diag, n_cand,
@@ -727,6 +936,7 @@ class DeviceCorrector:
         use_mask_as_ignore: bool = True,
         seed_stride: int = 8, seed_min_votes: int = 2,
         collect_aln: bool = False,
+        budget_r=None, haplo: bool = False,
     ):
         """One correction pass (dynamic chunk count; the multi-pass loop
         without per-pass host syncs is :func:`fused_iterations`)."""
@@ -773,18 +983,21 @@ class DeviceCorrector:
         sread, strand, lread, diag = _pad_candidates(
             sread, strand, lread, diag, R_need)
 
-        call, n_admitted, scalars, slabs = _fused_pass(
+        call, n_admitted, scalars, slabs, hpl = _fused_pass(
             map_flat, ignore_flat, codes, qual, lengths,
             q_codes, rc_codes, q_qual, q_lengths,
             sread, strand, lread, diag,
             jnp.asarray(n_cand, jnp.int32),
             m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
-            interpret=self.interpret, collect=collect_aln)
+            interpret=self.interpret, collect=collect_aln,
+            budget_r=budget_r, haplo=haplo)
         log.debug("correct_pass: seed-enqueue %.0f ms, n_cand sync %.0f ms, "
                   "fused-enqueue %.0f ms (n_cand=%d, chunks=%d)",
                   (_t1 - _t0) * 1e3, (_t2 - _t1) * 1e3,
                   (_time.time() - _t2) * 1e3, n_cand, n_chunks)
         stats = DevicePassStats(n_candidates=n_cand, n_admitted=n_admitted)
+        if haplo and not collect_aln:
+            return call, stats, hpl
         if not collect_aln:
             return call, stats
 

@@ -33,6 +33,14 @@ from proovread_tpu.pipeline.trim import TrimParams, trim_records
 log = logging.getLogger("proovread_tpu")
 
 
+def natural_key(s: str):
+    """The reference's ``byfile`` ordering (bin/proovread:1904-1920): digit
+    runs compare numerically, so ``read_2`` orders before ``read_10``."""
+    import re
+    return [int(p) if p.isdigit() else p
+            for p in re.split(r"(\d+)", s)]
+
+
 @dataclass
 class PipelineConfig:
     mode: str = "sr"                  # sr | mr (| *-noccs; ccs task pending)
@@ -55,6 +63,10 @@ class PipelineConfig:
     # (Pallas bsw + dseed + pileup kernels, pipeline/dcorrect.py); "scan" =
     # the host-admission lax.scan fallback (pipeline/correct.py)
     engine: str = "device"
+    # flex mode (proovread-flex): None = off; <= 0 = estimate each
+    # read's own-haplotype coverage per pass and tighten the next pass's
+    # admission budget; > 0 = explicit coverage cutoff (also auto-tightens)
+    haplo_coverage: Optional[float] = None
     device_chunk: int = 8192          # candidates per bsw kernel launch
     seed_stride: int = 8              # device-seeder probe stride
     length_slack: float = 0.2         # Lp headroom for consensus growth
@@ -110,6 +122,10 @@ class _SrDevice:
         import jax.numpy as jnp
 
         n = len(sel)
+        if n == self.pad_idx:
+            # full set (sampling off): the row gather would cost ~10ns per
+            # element on the scalar core for an identity permutation
+            return self.codes, self.rc, self.qual, self.lengths
         target = max(pad_multiple, -(-n // pad_multiple) * pad_multiple)
         idx = np.concatenate(
             [sel, np.full(target - n, self.pad_idx)]).astype(np.int32)
@@ -139,7 +155,7 @@ class Pipeline:
                 ignored.append((r.id, "too short"))
                 continue
             kept.append(r)
-        kept.sort(key=lambda r: r.id)  # natural-sorted output order
+        kept.sort(key=lambda r: natural_key(r.id))  # natural output order
         return kept, ignored
 
     # -- main -------------------------------------------------------------
@@ -186,7 +202,7 @@ class Pipeline:
                 results_final.extend(res_batch)
                 all_chim.extend(chim)
             # restore read_long's natural output order across buckets
-            results_final.sort(key=lambda r: r.record.id)
+            results_final.sort(key=lambda r: natural_key(r.record.id))
             untrimmed.extend(r.record for r in results_final)
         else:
             for start in range(0, len(kept), cfg.batch_reads):
@@ -252,10 +268,72 @@ class Pipeline:
                     else cfg.hcr_mask_late).scaled(min_sr_len)
 
         cns = _iter_cns()
-        ap1 = _align_params(cfg.mode, 1)
-        ap_rest = _align_params(cfg.mode, 2)
-        first_fused = 1 if ap1 == ap_rest else 2
-        if first_fused == 2:
+        flex_budget = None
+        if cfg.haplo_coverage is not None:
+            if cfg.haplo_coverage > 0:
+                flex_budget = jnp.full(
+                    codes.shape[0], cfg.haplo_coverage * cns.bin_size,
+                    jnp.float32)
+            # flex mode (bin/proovread-flex): every pass runs eagerly so
+            # the on-device haplo-coverage estimate of pass k can tighten
+            # pass k+1's per-read admission budget (Sam/Seq.pm:666-701,
+            # filter_by_coverage :1059-1084 folded into admission). The
+            # upstream mainline path for this mode is unfinished (bam2cns
+            # dies at 'haploc_consensus??'); this is the working semantic
+            # of the haplo machinery expressed in the iteration loop.
+            fixed = flex_budget                      # explicit cutoff row
+            it = 1
+            while it <= cfg.n_iterations:
+                ap_i = _align_params(cfg.mode, it)
+                sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
+                    if cfg.sampling else np.arange(n_short)
+                qc, rcq, qq, qlen = sr_dev.take(sel)
+                # stage 1: UNCAPPED pass, only for the haplo estimate —
+                # the estimate must come from the full pile BEFORE any
+                # consensus rewrites the read toward the deeper haplotype
+                # (Sam/Seq.pm:666-701 estimates and filters within one
+                # consensus call); its consensus output is discarded
+                _, _, hpl = dc.correct_pass(
+                    codes, qual, lengths, mask_cols, qc, rcq, qq, qlen,
+                    ap_i, cns, seed_stride=cfg.seed_stride, haplo=True)
+                # running min across iterations: once masking hides the
+                # variant columns the per-pass estimate degenerates to
+                # +inf, but the early-pass estimate still applies
+                new_b = hpl * cns.bin_size
+                flex_budget = (new_b if flex_budget is None
+                               else jnp.minimum(flex_budget, new_b))
+                if fixed is not None:
+                    flex_budget = jnp.minimum(flex_budget, fixed)
+                # stage 2: the same pass with the tightened budget
+                call, stats = dc.correct_pass(
+                    codes, qual, lengths, mask_cols, qc, rcq, qq, qlen,
+                    ap_i, cns, seed_stride=cfg.seed_stride,
+                    budget_r=flex_budget)
+                codes, qual, lengths = device_assemble(call, lengths, Lp)
+                mask_cols, frac = device_hcr_mask(
+                    qual, lengths, _mask_p(it))
+                new_frac, n_adm = jax.device_get(
+                    (frac, stats.n_admitted))
+                gain = float(new_frac) - masked_frac
+                masked_frac = float(new_frac)
+                task = f"bwa-{cfg.mode[:2]}-{it}"
+                reports.append(TaskReport(task, masked_frac,
+                                          stats.n_candidates, int(n_adm)))
+                log.info("%s: masked %.1f%% (flex)", task,
+                         masked_frac * 100)
+                it += 1
+                if (masked_frac > cfg.mask_shortcut_frac
+                        or gain < cfg.mask_min_gain_frac):
+                    log.info("mask shortcut: skipping to finish "
+                             "(masked %.3f, gain %.3f)", masked_frac, gain)
+                    break
+            first_fused = cfg.n_iterations + 1       # no fused passes
+            ap_rest = _align_params(cfg.mode, 2)
+        else:
+            ap1 = _align_params(cfg.mode, 1)
+            ap_rest = _align_params(cfg.mode, 2)
+            first_fused = 1 if ap1 == ap_rest else 2
+        if cfg.haplo_coverage is None and first_fused == 2:
             # mr mode: the BWA_MR_1 opener uses different align params from
             # the rest of the schedule, and the fused program is built
             # around ONE static schedule entry — run pass 1 eagerly
@@ -280,7 +358,10 @@ class Pipeline:
                 log.info("mask shortcut: skipping to finish "
                          "(masked %.3f, gain %.3f)", masked_frac, gain)
                 first_fused = cfg.n_iterations + 1   # no fused passes
-        else:
+        elif cfg.haplo_coverage is None:
+            # sr mode feeds the whole schedule to the fused program with an
+            # empty starting mask; the flex branch above keeps ITS final
+            # mask (it never enters the fused program)
             mask_cols = jnp.zeros_like(codes, dtype=bool)
 
         n_fused = cfg.n_iterations - first_fused + 1
@@ -292,12 +373,19 @@ class Pipeline:
                 sels_l.append(
                     sampler.select(n_short, coverage, cfg.sr_coverage)
                     if cfg.sampling else np.arange(n_short))
+            # every-pass-full-set: skip the per-pass query gather entirely
+            # (an identity permutation still runs at scalar-core speed)
+            full_set = all(len(s) == n_short for s in sels_l)
             Rsel = max(max(len(s) for s in sels_l), 512)
             Rsel = -(-Rsel // 512) * 512
-            sels = np.full((n_fused, Rsel), sr_dev.pad_idx, np.int32)
+            if full_set:
+                sels = np.zeros((n_fused, 1), np.int32)
+            else:
+                sels = np.full((n_fused, Rsel), sr_dev.pad_idx, np.int32)
+                for k, s in enumerate(sels_l):
+                    sels[k, :len(s)] = s[:Rsel]
             pvs = np.zeros((n_fused, 6), np.float32)
             for k, s in enumerate(sels_l):
-                sels[k, :len(s)] = s[:Rsel]
                 pvs[k] = np.asarray(mask_params_vec(
                     _mask_p(first_fused + k)))
             # candidate budget: ~2 per sampled read upper-bounds the
@@ -315,7 +403,7 @@ class Pipeline:
                 cns=cns, interpret=dc.interpret, n_rest=n_fused, Lp=Lp,
                 seed_stride=cfg.seed_stride, seed_min_votes=2,
                 shortcut_frac=cfg.mask_shortcut_frac,
-                min_gain=cfg.mask_min_gain_frac)
+                min_gain=cfg.mask_min_gain_frac, full_set=full_set)
             codes, qual, lengths, mask_cols = out[:4]
             # ONE RPC for the whole schedule's KPIs
             n_done, fracs, ncands, nadms = jax.device_get(out[4:])
@@ -342,11 +430,21 @@ class Pipeline:
         sel = sampler.select(n_short, coverage, cfg.finish_coverage) \
             if cfg.sampling else np.arange(n_short)
         qc, rcq, qq, qlen = sr_dev.take(sel)
+        if cfg.haplo_coverage is not None:
+            # the finish remaps UNMASKED, so its own estimate is valid
+            # again — refresh the running-min budget before consensing
+            _, _, hpl = dc.correct_pass(
+                codes, qual, lengths, None, qc, rcq, qq, qlen, ap, cns,
+                seed_stride=cfg.seed_stride, haplo=True)
+            new_b = hpl * cns.bin_size
+            flex_budget = (new_b if flex_budget is None
+                           else jnp.minimum(flex_budget, new_b))
         import time as _time
         _t0 = _time.time()
         call, stats, aln = dc.correct_pass(
             codes, qual, lengths, None, qc, rcq, qq, qlen, ap, cns,
-            seed_stride=cfg.seed_stride, collect_aln=True)
+            seed_stride=cfg.seed_stride, collect_aln=True,
+            budget_r=flex_budget)
         log.debug("finish correct_pass: %.0f ms", (_time.time() - _t0) * 1e3)
 
         # the single corrected-read fetch + host assembly (trim needs the

@@ -38,7 +38,8 @@ def _trim_params(cfg: Config) -> TrimParams:
 
 
 def _pipeline_config(cfg: Config, mode: str, tasks: Sequence[str],
-                     coverage, lr_min_length, sampling) -> PipelineConfig:
+                     coverage, lr_min_length, sampling,
+                     haplo=None) -> PipelineConfig:
     base = "mr" if mode.startswith("mr") else "sr"
     n_iter = sum(1 for t in tasks
                  if t.startswith(f"bwa-{base}-") and not t.endswith("finish"))
@@ -58,6 +59,7 @@ def _pipeline_config(cfg: Config, mode: str, tasks: Sequence[str],
             cfg.get("hcr-mask", late_task)),
         lr_min_length=lr_min_length,
         sampling=sampling,
+        haplo_coverage=haplo,
         trim=_trim_params(cfg),
         indel_taboo_length=int(cfg.get("sr-indel-taboo-length")),
         coverage_scale=float(cfg.get("coverage-scale-factor")),
@@ -135,6 +137,15 @@ def run_tasks(
             max_coverage=int(cfg.get("max-coverage", task)),
             rep_coverage=int(cfg.get("rep-coverage", task) or 0),
         )
+        if haplo_coverage is not None and haplo_coverage <= 0:
+            # bare --haplo-coverage means on-device estimation, which the
+            # external-mapping path has no pileup for; a negative value
+            # must never reach filter_by_coverage (it would evict every
+            # bin down to 2 alignments)
+            log.warning("%s: --haplo-coverage without a value has no "
+                        "effect in sam/bam re-entry mode — give an "
+                        "explicit coverage cutoff", task)
+            haplo_coverage = None
         s2c = Sam2CnsConfig(
             params=params,
             detect_chimera=bool(cfg.get("detect-chimera", task)),
@@ -174,7 +185,7 @@ def run_tasks(
         if not shorts:
             raise ValueError(f"mode {mode!r} needs -s/--short-reads input")
         pc = _pipeline_config(cfg, mode, tasks, coverage, lr_min_length,
-                              sampling)
+                              sampling, haplo=haplo_coverage)
         pipe = Pipeline(pc)
         result = pipe.run(longs, shorts)
         result.reports = reports + result.reports

@@ -105,11 +105,64 @@ def siamaera_tool(argv: List[str]) -> int:
     return 0
 
 
+def dazz2sam_tool(argv: List[str]) -> int:
+    """bin/dazz2sam role: ``dazz2sam <lashow.txt> [--ref ref.fa]
+    [--qry qry.fa] [--add-scores] [out.sam]`` — consumes ``LAshow -a``
+    textual output (the DAZZLER binaries are not shipped here; see
+    pipeline/dazz2sam.py for the documented deviation)."""
+    from proovread_tpu.pipeline.dazz2sam import (
+        las2sam, names_and_lengths_from_fasta, parse_lashow)
+
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m proovread_tpu.tools dazz2sam "
+              "<lashow.txt> [--ref ref.fa] [--qry qry.fa] [--add-scores] "
+              "[out.sam]", file=sys.stderr)
+        return 2
+    las_path = argv[0]
+    rest = argv[1:]
+    ref_names = qry_names = qry_lengths = ref_lengths = None
+    add_scores = False
+    out_path = None
+    i = 0
+    while i < len(rest):
+        if rest[i] in ("--ref", "--qry"):
+            if i + 1 >= len(rest):
+                print(f"error: {rest[i]} needs a FASTA path",
+                      file=sys.stderr)
+                return 2
+            names, lengths = names_and_lengths_from_fasta(rest[i + 1])
+            if rest[i] == "--ref":
+                ref_names, ref_lengths = names, lengths
+            else:
+                qry_names, qry_lengths = names, lengths
+            i += 2
+        elif rest[i] in ("--add-scores", "-S"):
+            add_scores = True
+            i += 1
+        elif rest[i].startswith("-"):
+            print(f"error: unknown option {rest[i]!r}", file=sys.stderr)
+            return 2
+        else:
+            out_path = rest[i]
+            i += 1
+    with open(las_path) as fh:
+        alns = parse_lashow(fh)
+    out = open(out_path, "w") if out_path else sys.stdout
+    n = las2sam(alns, out, ref_names=ref_names, qry_names=qry_names,
+                qry_lengths=qry_lengths, ref_lengths=ref_lengths,
+                add_scores=add_scores)
+    if out_path:
+        out.close()
+    print(f"dazz2sam: {n} alignments converted", file=sys.stderr)
+    return 0
+
+
 _TOOLS = {
     "samfilter": samfilter,
     "sam2cns": sam2cns_tool,
     "ccseq": ccseq_tool,
     "siamaera": siamaera_tool,
+    "dazz2sam": dazz2sam_tool,
 }
 
 

@@ -1,0 +1,106 @@
+"""dazz2sam: LAshow-text -> SAM conversion (bin/dazz2sam parity).
+
+The fixture mimics ``LAshow REF QRY LAS -a -U -w80 -b0`` output: header
+lines with iid pair, orientation, ref x query intervals; then wrapped
+(ref, diff, qry) row triplets. Expectations are hand-derived from the
+reference's aln2cigar/aln2score rules (bin/dazz2sam:22-29,322-367).
+"""
+
+import io
+
+from proovread_tpu.pipeline.dazz2sam import (aln2cigar, aln2score, las2sam,
+                                             parse_lashow)
+
+LASHOW = """\
+
+REF.db QRY.db LAS: 3 records
+
+     1      1 n   [     4..    16] x [     2..    13]  ~   8.3%
+
+         4 acgtacg-tacgt
+           |||||||*|||||
+         2 acgaacgttac-t
+
+     1      2 c   [    20..    28] x [     1..     9]  ~   0.0%
+
+        20 acgtacgt
+           ||||||||
+         1 acgtacgt
+
+     2      2 n   [     0..    90] x [     1..    91]  ~   2.2%
+
+         0 aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa
+           ||||||||||||||||||||||||||||||||||||||||||||||||||||||||||||
+         1 aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa
+        60 aaaaaaaaaaaaaaaaaaaaaaaaaaaaaa
+           ||||||||||||||||||||||||||||||
+        61 aaaaaaaaaaaaaaaaaaaaaaaaaaaaaa
+"""
+
+
+class TestParse:
+    def test_records_and_rows(self):
+        alns = parse_lashow(io.StringIO(LASHOW))
+        assert len(alns) == 3
+        a = alns[0]
+        assert (a.riid, a.qiid, a.comp) == (1, 1, False)
+        assert (a.rstart, a.rend, a.qstart, a.qend) == (4, 16, 2, 13)
+        assert a.rseq == "acgtacg-tacgt"
+        assert a.qseq == "acgaacgttac-t"
+        assert alns[1].comp is True
+        # wrapped rows concatenate
+        assert len(alns[2].rseq) == 90
+        assert len(alns[2].qseq) == 90
+
+
+class TestCigarScore:
+    def test_aln2cigar(self):
+        # ref gap -> I, qry gap -> D, else M; head clip qstart-1, tail
+        # clip qlen - qend (bin/dazz2sam:322-341)
+        cig = aln2cigar("acgtacg-tacgt", "acgaacgttac-t", 2, 13, 20)
+        assert cig == "1H7M1I3M1D1M7H"
+
+    def test_aln2cigar_no_clips(self):
+        assert aln2cigar("acgt", "acgt", 1, 4, 4) == "4M"
+
+    def test_aln2score(self):
+        # 11 matches, 1 mismatch, 1 ref gap open, 1 qry gap open
+        s = aln2score("acgtacg-tacgt", "acgaacgttac-t")
+        assert s == 5 * 10 - 11 * 1 - 2 * 1 - 1 * 1
+
+    def test_score_gap_extension(self):
+        # ref run of 3: 1 open + 2 extends; the gapped columns are not
+        # mismatches (bin/dazz2sam:360-362), so 4 matches remain
+        s = aln2score("ac---gt", "acgtagt")
+        assert s == 5 * 4 - 2 * 1 - 4 * 2
+
+
+class TestSam:
+    def test_las2sam_records(self):
+        alns = parse_lashow(io.StringIO(LASHOW))
+        out = io.StringIO()
+        n = las2sam(alns, out,
+                    ref_names={1: "r1", 2: "r2"},
+                    qry_names={1: "q1", 2: "q2"},
+                    qry_lengths={"q1": 20, "q2": 91},
+                    ref_lengths={"r1": 50, "r2": 120},
+                    add_scores=True)
+        assert n == 3
+        all_lines = out.getvalue().splitlines()
+        # reference header block (bin/dazz2sam:222-228)
+        assert all_lines[0].startswith("@HD")
+        assert all_lines[1] == "@SQ\tSN:r1\tLN:50"
+        assert all_lines[2] == "@SQ\tSN:r2\tLN:120"
+        assert all_lines[3].startswith("@PG")
+        lines = [ln.split("\t") for ln in all_lines
+                 if not ln.startswith("@")]
+        # record 1: plus strand, pos rstart+1, seq = gap-stripped qry
+        assert lines[0][0] == "q1" and lines[0][1] == "0"
+        assert lines[0][2] == "r1" and lines[0][3] == "5"
+        assert lines[0][5] == "1H7M1I3M1D1M7H"
+        assert lines[0][9] == "acgaacgttact"
+        assert lines[0][11].startswith("AS:i:")
+        # record 2: complemented
+        assert lines[1][1] == "16" and lines[1][3] == "21"
+        # record 3: same qiid again -> secondary flag
+        assert int(lines[2][1]) & 0x100

@@ -1,0 +1,101 @@
+"""proovread-flex parity: --haplo-coverage in the main sr loop.
+
+Scenario: a long read from haplotype A whose locus is covered 8x by
+A-derived short reads and 30x by B-derived short reads (B = A with SNPs
+every ~60 bp). Without flex, the deeper B pile outvotes A at every SNP;
+with flex, the on-device haplo-coverage estimate (Sam/Seq.pm:1136-1172)
+tightens the per-read admission budget so the top-scoring (A-agreeing)
+alignments dominate and the SNP columns stay A.
+"""
+
+import numpy as np
+import pytest
+
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
+from proovread_tpu.pipeline import Pipeline, PipelineConfig
+
+
+def _make_case(seed=0, L=600, snp_every=60, cov_a=8, cov_b=30):
+    rng = np.random.default_rng(seed)
+    hap_a = rng.integers(0, 4, L).astype(np.int8)
+    hap_b = hap_a.copy()
+    snps = np.arange(snp_every // 2, L - 10, snp_every)
+    for p in snps:
+        hap_b[p] = (hap_b[p] + 1 + rng.integers(0, 3)) % 4
+
+    # the long read: haplotype A with light CLR-style noise (subs only so
+    # SNP positions stay addressable)
+    lr = hap_a.copy()
+    noise = rng.random(L) < 0.04
+    lr[noise] = (lr[noise] + 1 + rng.integers(0, 3, int(noise.sum()))) % 4
+    lr[snps] = hap_a[snps]            # keep the discriminating columns clean
+
+    def reads_from(hap, cov, tag):
+        n = int(cov * L / 100)
+        out = []
+        for i in range(n):
+            st = int(rng.integers(0, L - 100))
+            seq = hap[st:st + 100].copy()
+            if rng.random() < 0.5:
+                seq = revcomp_codes(seq)
+            out.append(SeqRecord(f"{tag}{i}", decode_codes(seq),
+                                 qual=np.full(100, 30, np.uint8)))
+        return out
+
+    srs = reads_from(hap_a, cov_a, "a") + reads_from(hap_b, cov_b, "b")
+    return SeqRecord("read_1", decode_codes(lr)), srs, hap_a, hap_b, snps
+
+
+def _snp_calls(corrected, hap_a, hap_b, snps):
+    """Count SNP positions where the corrected read matches A vs B, read
+    off an alignment-free exact window match around each SNP."""
+    cor = encode_ascii(corrected.seq)
+    a_n = b_n = 0
+    for p in snps:
+        lo, hi = p - 8, p + 9
+        wa = hap_a[lo:hi].copy()
+        wb = hap_b[lo:hi].copy()
+        # search the corrected read near p for either window
+        lo2, hi2 = max(0, p - 40), min(len(cor), p + 40)
+        seg = cor[lo2:hi2]
+        for s in range(len(seg) - len(wa)):
+            w = seg[s:s + len(wa)]
+            if (w == wa).all():
+                a_n += 1
+                break
+            if (w == wb).all():
+                b_n += 1
+                break
+    return a_n, b_n
+
+
+@pytest.mark.slow
+class TestFlexMode:
+    def test_haplo_budget_flips_snp_calls(self):
+        lr, srs, hap_a, hap_b, snps = _make_case()
+
+        def run(haplo):
+            pipe = Pipeline(PipelineConfig(
+                mode="sr", n_iterations=2, sampling=False,
+                sr_coverage=100.0, finish_coverage=100.0,
+                device_chunk=512, haplo_coverage=haplo))
+            return pipe.run([lr], srs)
+
+        res_plain = run(None)
+        res_flex = run(-1.0)
+        a_plain, b_plain = _snp_calls(res_plain.untrimmed[0],
+                                      hap_a, hap_b, snps)
+        a_flex, b_flex = _snp_calls(res_flex.untrimmed[0],
+                                    hap_a, hap_b, snps)
+        # without flex the deep B pile contaminates SNP columns (the
+        # PacBio scoring also lets B mismatches align as indel pairs, so
+        # not every SNP flips cleanly to B); with flex the read's own (A)
+        # haplotype is preserved outright
+        assert b_plain >= 3, (a_plain, b_plain)
+        # one SNP may still slip where the read's own coverage locally
+        # dips below the budget (the admission crossing rule lets the
+        # first over-budget alignment through)
+        assert b_flex <= 1, (a_flex, b_flex)
+        assert a_flex > a_plain, (a_plain, a_flex)
+        assert a_flex >= len(snps) - 2

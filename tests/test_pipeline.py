@@ -311,3 +311,21 @@ class TestPipelineEndToEnd:
                          for r in res.untrimmed])
         assert after > before + 0.1, (before, after)
         assert after > 0.9, after
+
+
+class TestNaturalOrder:
+    def test_natural_key(self):
+        from proovread_tpu.pipeline.driver import natural_key
+        ids = ["read_10", "read_2", "read_1", "read_2b", "other"]
+        assert sorted(ids, key=natural_key) == [
+            "other", "read_1", "read_2", "read_2b", "read_10"]
+
+    def test_read_long_natural_order(self):
+        from proovread_tpu.io.records import SeqRecord
+        from proovread_tpu.pipeline import Pipeline, PipelineConfig
+
+        recs = [SeqRecord(f"read_{i}", "ACGT" * 200)
+                for i in (10, 2, 1, 21, 3)]
+        kept, _ = Pipeline(PipelineConfig()).read_long(recs, 100)
+        assert [r.id for r in kept] == [
+            "read_1", "read_2", "read_3", "read_10", "read_21"]
