@@ -1,0 +1,14 @@
+# Convenience targets; the package itself needs no build step.
+
+.PHONY: test test-all bench
+
+# fast regression loop (skips @slow end-to-end tests; target < 2 min)
+test:
+	python -m pytest tests/ -q
+
+# the whole suite, slow end-to-end tests included
+test-all:
+	python -m pytest tests/ -q -m ''
+
+bench:
+	python bench.py

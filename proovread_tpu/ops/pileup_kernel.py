@@ -113,12 +113,15 @@ def _accum_bits_kernel(read_of_ref, w0_ref, pile_in_ref, b0_ref, b1_ref,
     b0 = b0_ref[...][:, :, None]                      # [rb, n, 1]
     b1 = b1_ref[...][:, :, None]
     P2 = 2 * PACK_LANES
-    W = jnp.concatenate(
-        [jnp.broadcast_to(b0, (rb, n, 32)),
-         jnp.broadcast_to(b1, (rb, n, 32)),
-         jnp.zeros((rb, n, P2 - 64), jnp.int32)], axis=2)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (rb, n, P2), 2) & 31
-    vf = ((W >> lane) & 1).astype(jnp.float32)
+    lane32 = jax.lax.broadcasted_iota(jnp.int32, (rb, n, 32), 2)
+    # per-plane expansion in bf16: the [rb, n, 128] i32 intermediate of a
+    # single wide shift would cost ~6.5MB of the scoped-VMEM budget that
+    # long-read buckets need for the accumulator
+    v0 = ((jnp.broadcast_to(b0, (rb, n, 32)) >> lane32) & 1)
+    v1 = ((jnp.broadcast_to(b1, (rb, n, 32)) >> lane32) & 1)
+    vf = jnp.concatenate(
+        [v0.astype(jnp.bfloat16), v1.astype(jnp.bfloat16),
+         jnp.zeros((rb, n, P2 - 64), jnp.bfloat16)], axis=2)
 
     for k in range(rb):
         g = i * rb + k
@@ -136,7 +139,8 @@ def _accum_bits_kernel(read_of_ref, w0_ref, pile_in_ref, b0_ref, b1_ref,
             ld.wait()
             rcur_ref[0] = nxt
 
-        acc_ref[pl.ds(w0_ref[g], n), :] += vf[k]
+        w0 = pl.multiple_of(w0_ref[g], 16)
+        acc_ref[pl.ds(w0, n), :] += vf[k]
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -150,11 +154,11 @@ PILEUP_BLOCK = 64
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pileup_accumulate_bits(
-    pileup_packed: jnp.ndarray,   # f32 [B, Lp, 2*PACK_LANES]
+    pileup_packed: jnp.ndarray,   # bf16 [B, Lp, 2*PACK_LANES]
     bits0: jnp.ndarray,           # i32 [R, n] vote-lane bits 0-31
     bits1: jnp.ndarray,           # i32 [R, n] vote-lane bits 32-63
     read_of: jnp.ndarray,         # i32 [R] sorted ascending
-    w0: jnp.ndarray,              # i32 [R] padded window offset, 8-aligned
+    w0: jnp.ndarray,              # i32 [R] padded window offset, 16-aligned
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Blocked bitmask twin of :func:`pileup_accumulate_packed` (same vote
@@ -164,12 +168,17 @@ def pileup_accumulate_bits(
 
     The buffer is 128 lanes wide because the per-read DMA slice must align
     to the (1, 128) HBM tiling — a 64-lane minor dim is physically padded
-    and Mosaic rejects the unaligned slice. ``w0`` must be 8-aligned so the
-    accumulator read-modify-write hits whole sublane tiles."""
+    and Mosaic rejects the unaligned slice. ``w0`` must be 16-aligned so the
+    bf16 accumulator read-modify-write hits whole (16, 128) tiles.
+
+    The buffer and accumulator are bf16 so a 32kb-read bucket's per-read
+    accumulator fits scoped VMEM; vote counts are small integers (bounded
+    by the admission coverage cap), exact in bf16 up to 256."""
     B, Lp, P = pileup_packed.shape
     R, n = bits0.shape
     rb = PILEUP_BLOCK
     assert P == 2 * PACK_LANES
+    assert pileup_packed.dtype == jnp.bfloat16, pileup_packed.dtype
     assert R % rb == 0, (R, rb)
 
     grid = (R // rb,)
@@ -188,12 +197,12 @@ def pileup_accumulate_bits(
             ],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
             scratch_shapes=[
-                pltpu.VMEM((Lp, P), jnp.float32),
+                pltpu.VMEM((Lp, P), jnp.bfloat16),
                 pltpu.SMEM((1,), jnp.int32),
                 pltpu.SemaphoreType.DMA(()),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, Lp, P), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, Lp, P), jnp.bfloat16),
         input_output_aliases={2: 0},
         interpret=interpret,
     )(read_of, w0, pileup_packed, bits0, bits1)

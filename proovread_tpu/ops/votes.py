@@ -357,10 +357,11 @@ def word_to_bits(word: jnp.ndarray):
 
 
 def unpack_pileup(pileup_packed: jnp.ndarray, pad: int, length: int):
-    """Packed [B, pad + L + pad, PACK_LANES] -> Pileup tensors."""
+    """Packed [B, pad + L + pad, PACK_LANES+] -> Pileup tensors (f32; the
+    bits-kernel buffer is bf16 with exact small-integer counts)."""
     from proovread_tpu.ops.pileup import Pileup
 
-    core = pileup_packed[:, pad:pad + length, :]
+    core = pileup_packed[:, pad:pad + length, :].astype(jnp.float32)
     K = INS_CAP
     counts = core[:, :, 0:N_STATES]
     ins_mbase = core[:, :, 8:8 + N_STATES]

@@ -479,12 +479,12 @@ def _gather_and_align(map_flat, q_codes, rc_codes, q_qual, q_lengths,
         qual = None
     qlen = q_lengths[sread]
 
-    # 8-aligned window starts: the pileup kernel's accumulator RMW then
-    # hits whole sublane tiles (w0p stays 8-aligned through the clip). The
-    # <=7-lane rightward shift of the band center is absorbed by the 2x
-    # band slack of band_lanes() and is small against the seeder's diag
-    # quantization (quant = band_width // 2 >= 15)
-    win_start = (diag - W // 2) & ~7
+    # 16-aligned window starts: the pileup kernel's bf16 accumulator RMW
+    # then hits whole (16, 128) sublane tiles (w0p stays aligned through
+    # the clip). The <=15-lane rightward shift of the band center is
+    # absorbed by the 2x band slack of band_lanes() and is comparable to
+    # the seeder's diag quantization (quant = band_width // 2 >= 15)
+    win_start = (diag - W // 2) & ~15
     idx = win_start[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
     inb = (idx >= 0) & (idx < L)
     flat_idx = lread[:, None] * L + jnp.clip(idx, 0, L - 1)
@@ -529,8 +529,10 @@ def _fused_pass_unrolled(map_flat, ignore_flat, codes, qual, lengths,
     # the unweighted path's blocked pileup kernel needs a 128-lane buffer
     # (per-read DMA slices must align to the (1, 128) HBM tiling); the
     # weighted path's slab kernel streams 64-lane blocks
-    P_buf = PACK_LANES if cns.qual_weighted else 2 * PACK_LANES
-    pileup = jnp.zeros((B, Lpile, P_buf), jnp.float32)
+    if cns.qual_weighted:
+        pileup = jnp.zeros((B, Lpile, PACK_LANES), jnp.float32)
+    else:
+        pileup = jnp.zeros((B, Lpile, 2 * PACK_LANES), jnp.bfloat16)
 
     def _dead_chunk():
         """Same pytree as a live chunk, all-dead: lets callers provision
@@ -728,7 +730,7 @@ def _fused_pass_scanned(map_flat, ignore_flat, codes, qual, lengths,
         lengths, cns, budget_r=budget_r)
     adm_s = admitted.reshape(nc, CH)
 
-    pileup0 = jnp.zeros((B, Lpile, 2 * PACK_LANES), jnp.float32)
+    pileup0 = jnp.zeros((B, Lpile, 2 * PACK_LANES), jnp.bfloat16)
 
     def scan_vote(pileup, x):
         (st_c, qr_c, il_c, b0_c, b1_c, qs_c, qe_c, ws_c, adm_c,

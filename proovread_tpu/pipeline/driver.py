@@ -181,8 +181,10 @@ class Pipeline:
         # queries pad to an 8-row multiple, not 128: the bsw kernel runs
         # one DP step per padded query row, so 100bp reads at pad 128
         # would waste 28% of the forward pass
+        # 16 keeps n = m + W a multiple of 16, which keeps the pileup
+        # kernel's window offsets on bf16 (16, 128) tile boundaries
         sr_all = pack_reads(short_records,
-                            pad_multiple=8 if cfg.engine == "device"
+                            pad_multiple=16 if cfg.engine == "device"
                             else 128)
 
         untrimmed: List[SeqRecord] = []

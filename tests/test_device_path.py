@@ -240,9 +240,9 @@ class TestVoteParity:
         lr, q, win, qual, qlen, read_idx, w0 = _make_candidates(seed=17)
         B, L = lr.shape
         R, n = win.shape
-        # the bits kernel requires 8-aligned window offsets (production
+        # the bits kernel requires 16-aligned window offsets (production
         # aligns win_start in _gather_and_align); re-cut the windows
-        w0 = (w0 & ~7).astype(np.int32)
+        w0 = (w0 & ~15).astype(np.int32)
         for i in range(R):
             win[i] = lr[read_idx[i], w0[i]:w0[i] + n]
         rb, rs = _bsw_both(q, win, qlen)
@@ -261,7 +261,7 @@ class TestVoteParity:
         words = jnp.where(jnp.asarray(admitted)[:, None], words, 0)
         b0, b1 = word_to_bits(words)
         pad = n
-        packed = jnp.zeros((B, L + 2 * n, 2 * PACK_LANES), jnp.float32)
+        packed = jnp.zeros((B, L + 2 * n, 2 * PACK_LANES), jnp.bfloat16)
         w0p = jnp.clip(jnp.asarray(w0) + pad, 0, L + 2 * n - n)
         packed = pileup_accumulate_bits(packed, b0, b1,
                                         jnp.asarray(read_idx), w0p,
