@@ -100,40 +100,67 @@ def _ecoli_class_workload():
     return longs, srs, truth, 6
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, default=3, choices=(1, 2, 3))
-    args = ap.parse_args()
+def _log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
+
+def _retry(fn, what, tries=4):
+    """Retry transient tunneled-runtime failures (the round-4 driver run
+    died on 'remote_compile: response body closed' during warm-up). The
+    persistent compile cache makes retries RESUME: every program compiled
+    before the failure is served from disk, so each attempt strictly
+    progresses through the remaining compiles."""
     import jax
-    # persistent compile cache: steady-state numbers, not XLA compile time
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
+    for attempt in range(1, tries + 1):
+        try:
+            return fn()
+        except jax.errors.JaxRuntimeError as e:
+            msg = str(e)
+            transient = any(s in msg for s in (
+                "remote_compile", "INTERNAL", "UNAVAILABLE",
+                "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED"))
+            if not transient or attempt == tries:
+                raise
+            wait = 15 * attempt
+            head = (msg.splitlines() or [""])[0][:200]
+            _log(f"{what}: transient runtime error "
+                 f"(attempt {attempt}/{tries}), retrying in {wait}s: "
+                 f"{head}")
+            time.sleep(wait)
+
+
+def _bench_config(config: int) -> dict:
     from proovread_tpu.ops.encode import encode_ascii
     from proovread_tpu.pipeline import Pipeline, PipelineConfig
 
-    if args.config == 1:
+    _log(f"config {config}: building workload")
+    if config == 1:
         longs, srs, truth, n_it = _fantasticus_workload(6)
-    elif args.config == 2:
+    elif config == 2:
         longs, srs, truth, n_it = _fantasticus_workload(3)
     else:
         longs, srs, truth, n_it = _ecoli_class_workload()
     total_bases = sum(len(r) for r in longs)
+    _log(f"config {config}: {len(longs)} reads / {total_bases} bases")
 
     def run_once():
         pipe = Pipeline(PipelineConfig(mode="sr", n_iterations=n_it,
                                        sampling=True, engine="device"))
         return pipe.run(longs, srs)
 
-    run_once()                      # warm the compile cache
+    _log("warm-up run (compiles)")
+    _retry(run_once, "warm-up")
     times = []
-    for _ in range(3):
+    res = None
+    for k in range(3):
+        _log(f"timed run {k + 1}/3")
         t0 = time.time()
-        res = run_once()
+        res = _retry(run_once, f"timed run {k + 1}")
         times.append(time.time() - t0)
     dt = float(np.median(times))
     bases_per_sec = total_bases / dt
+    _log(f"median wall {dt:.2f}s -> {bases_per_sec:.0f} b/s; scoring")
 
     corrected = {r.id: r for r in res.untrimmed}
     # identity on a bounded sample (full SW traceback is quadratic in read
@@ -151,12 +178,12 @@ def main():
     id_before = float(np.mean(true_identity(pairs_before)))
     id_after = float(np.mean(true_identity(pairs_after)))
 
-    print(json.dumps({
+    return {
         "metric": "corrected_bases_per_sec_per_chip",
         "value": round(bases_per_sec, 1),
         "unit": "bases/sec/chip",
         "vs_baseline": round(bases_per_sec / BASELINE_BASES_PER_SEC, 3),
-        "config": args.config,
+        "config": config,
         "wall_s": round(dt, 2),
         "n_reads": len(longs),
         "total_bases": total_bases,
@@ -165,7 +192,43 @@ def main():
         if len(res.reports) > 1 else None,
         "identity_before": round(id_before, 4),
         "identity_after": round(id_after, 4),
-    }))
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=3, choices=(1, 2, 3))
+    ap.add_argument("--no-fallback", action="store_true",
+                    help="fail instead of falling back to config 1")
+    args = ap.parse_args()
+
+    # driver task lines on stderr: a failing run must show which stage/
+    # bucket it died in (the JSON result line is stdout-only)
+    import logging
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="[%(asctime)s] %(message)s",
+                        datefmt="%H:%M:%S")
+
+    import jax
+    # persistent compile cache: steady-state numbers, not XLA compile time
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    try:
+        out = _bench_config(args.config)
+    except Exception as e:                                  # noqa: BLE001
+        if args.no_fallback or args.config == 1:
+            raise
+        # the bench must never exit rc=1 without a number: record the
+        # failure and fall back to the small validated config
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        _log(f"config {args.config} failed ({type(e).__name__}); "
+             "falling back to config 1")
+        out = _bench_config(1)
+        out["fallback_from"] = args.config
+        out["fallback_error"] = (str(e).splitlines() or [""])[0][:300]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -103,15 +103,11 @@ def device_index(codes, lengths, k: int) -> DeviceIndex:
                        k=k, length=L, n_reads=B)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "L", "stride", "occ_cap", "slots", "quant",
-                     "max_occ", "min_votes", "shift"),
-)
-def _probe(index_kmers, index_gpos, index_starts, index_counts,
-           q_codes, q_lengths, rc_codes,
-           *, k, L, stride, occ_cap, slots, quant, max_occ, min_votes,
-           shift):
+def _probe_slab(index_kmers, index_gpos, index_starts, index_counts,
+                q_codes, q_lengths, rc_codes,
+                *, k, L, stride, occ_cap, slots, quant, max_occ, min_votes,
+                shift):
+    """One query slab's probe + clustering (the body of :func:`_probe`)."""
     Bq, m = q_codes.shape
     probes = []
     for strand, qc in ((0, q_codes), (1, rc_codes)):
@@ -180,10 +176,60 @@ def _probe(index_kmers, index_gpos, index_starts, index_counts,
         [neg_rank, keys_m, diag_m, votes_m], num_keys=1, dimension=-1)
     key_top = key_s[..., :slots]
     lread = jnp.where(key_top < INVALID, key_top // DQ_SPAN, -1)
+    return (lread.astype(jnp.int32),
+            diag_s[..., :slots].astype(jnp.int32),
+            votes_s[..., :slots].astype(jnp.int32))
+
+
+# queries per scanned probe slab: the O(S^2) clustering tensor is
+# [slab, 2, S, S] — at config-3 scale (~190k sampled short reads) a single
+# unscanned slab was a ~1GB intermediate inside a program whose tunneled
+# remote_compile failed (BENCH_r04); scanning bounds both the program size
+# and the transient to one slab regardless of query count
+PROBE_SLAB = 16384
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "stride", "occ_cap", "slots", "quant",
+                     "max_occ", "min_votes", "shift", "slab"),
+)
+def _probe(index_kmers, index_gpos, index_starts, index_counts,
+           q_codes, q_lengths, rc_codes,
+           *, k, L, stride, occ_cap, slots, quant, max_occ, min_votes,
+           shift, slab):
+    Bq, m = q_codes.shape
+    body = functools.partial(
+        _probe_slab, index_kmers, index_gpos, index_starts, index_counts,
+        k=k, L=L, stride=stride, occ_cap=occ_cap, slots=slots, quant=quant,
+        max_occ=max_occ, min_votes=min_votes, shift=shift)
+    if Bq <= slab:
+        lread, diag, votes = body(q_codes, q_lengths, rc_codes)
+        return DeviceCandidates(lread=lread, diag=diag, votes=votes)
+
+    ns = -(-Bq // slab)
+    padn = ns * slab - Bq
+    if padn:
+        # zero-length pad rows form no valid probes, hence no candidates
+        q_codes = jnp.concatenate(
+            [q_codes, jnp.full((padn, m), 4, q_codes.dtype)])
+        rc_codes = jnp.concatenate(
+            [rc_codes, jnp.full((padn, m), 4, rc_codes.dtype)])
+        q_lengths = jnp.concatenate(
+            [q_lengths, jnp.zeros(padn, q_lengths.dtype)])
+
+    def f(c, x):
+        return c, body(*x)
+
+    _, (lread, diag, votes) = jax.lax.scan(
+        f, 0, (q_codes.reshape(ns, slab, m),
+               q_lengths.reshape(ns, slab),
+               rc_codes.reshape(ns, slab, m)))
+    S = lread.shape[-1]
     return DeviceCandidates(
-        lread=lread.astype(jnp.int32),
-        diag=diag_s[..., :slots].astype(jnp.int32),
-        votes=votes_s[..., :slots].astype(jnp.int32),
+        lread=lread.reshape(ns * slab, 2, S)[:Bq],
+        diag=diag.reshape(ns * slab, 2, S)[:Bq],
+        votes=votes.reshape(ns * slab, 2, S)[:Bq],
     )
 
 
@@ -203,7 +249,7 @@ def probe_candidates(
         q_codes, q_lengths, rc_codes,
         k=index.k, L=index.length, stride=stride, occ_cap=occ_cap,
         slots=params.max_candidates, quant=quant, max_occ=params.max_occ,
-        min_votes=min_votes, shift=index.shift,
+        min_votes=min_votes, shift=index.shift, slab=PROBE_SLAB,
     )
 
 

@@ -34,6 +34,10 @@ class ConsensusResult:
     cigar: str                        # consensus->reference cigar (M/I/D)
     chimera: List[Tuple[int, int, float]] = field(default_factory=list)
     # (from, to, score) in corrected-sequence coords
+    # per-ref-column emitted base count (1 + ins_len, 0 for dropped cols);
+    # when present, emit_prefix derives coordinates from it directly — the
+    # device finish path fills this instead of building a cigar string
+    emit_counts: Optional[np.ndarray] = None
 
     @property
     def masked_frac(self) -> float:
@@ -223,6 +227,44 @@ class ConsensusEngine:
             rid, emitted, base, ins_len, ins_bases, freq, phred, coverage
         )
 
+    # -- variant calling (Sam/Seq.pm:1666-1734) --------------------------
+    def variant_table(
+        self,
+        refs: ReadBatch,
+        alnsets: Sequence[AlnSet],
+        min_freq: float = 4.0,
+        min_prob: float = 0.0,
+        or_min: bool = False,
+    ):
+        """Per-column variant call over the batch (``ops/variants.py``).
+
+        The state matrix is recomputed unweighted and without ref-qual
+        votes, as upstream ``call_variants`` does when it re-inits the
+        matrix with default options (Sam/Seq.pm:1676-1677) — regardless of
+        this engine's consensus weighting."""
+        from dataclasses import replace as _replace
+
+        from proovread_tpu.ops.variants import (call_variants,
+                                                majority_insertion,
+                                                variant_freqs)
+
+        B, L = refs.codes.shape
+        for aset in alnsets:
+            if aset.bin_bases is None:
+                aset.filter_by_scores()
+                aset.admit()
+        plain_engine = ConsensusEngine(
+            _replace(self.params, qual_weighted=False, use_ref_qual=False),
+            self.cell_budget)
+        expanded = plain_engine._expand_sets(alnsets)
+        pile = plain_engine._build_pileup(expanded, L)
+        vf = np.asarray(variant_freqs(pile))
+        mlen, mbases = majority_insertion(pile)
+        return call_variants(
+            vf, refs.lengths, min_freq=min_freq, min_prob=min_prob,
+            or_min=or_min,
+            ins_call=(np.asarray(mlen), np.asarray(mbases)))
+
 
     # -- chimera (Sam/Seq.pm:774-888 + bam2cns:461-491) ------------------
     def _chimera(
@@ -406,8 +448,17 @@ def window_counts(sel: Sequence[ColumnStates], mat_from: int, Wn: int) -> np.nda
 
 def emit_prefix(res: ConsensusResult, L: int) -> np.ndarray:
     """corrected-coordinate of each reference column (prefix sum of emitted
-    base counts), recovered from the consensus cigar."""
+    base counts), recovered from the consensus cigar — or directly from
+    ``emit_counts`` when the result carries it (device finish path)."""
     import re as _re
+
+    ec = getattr(res, "emit_counts", None)
+    if ec is not None:
+        emit = np.zeros(L + 1, np.int64)
+        n = min(len(ec), L)
+        emit[1:n + 1] = np.cumsum(ec[:n])
+        emit[n + 1:] = emit[n]
+        return emit
 
     emit = np.zeros(L + 1, np.int64)
     col = 0

@@ -92,13 +92,25 @@ def parse_lashow(fh: Iterable[str]) -> List[LasAlignment]:
                 qstart=_n(m.group(6)), qend=_n(m.group(7)),
                 rseq="", qseq="")
             continue
-        if cur is None or not line.strip():
+        if cur is None:
+            continue
+        # explicit slot tracking: after a header the rows cycle
+        # ref (0) -> diff (1) -> qry (2), with blank lines legal only
+        # BETWEEN triplets — except that a fully matching chunk renders
+        # its diff row with no markers at all, which must still occupy
+        # the diff slot or every following qry row parses as a ref row
+        slot = len(rows) % 3
+        if not line.strip():
+            if slot == 1:
+                rows.append("")      # whitespace-only diff row
+            continue
+        if slot == 1:
+            rows.append("")          # diff row (any content)
             continue
         rm = _ROW_RE.match(line)
-        if rm and len(rows) % 3 != 1:
-            rows.append(rm.group(1))
-        else:
-            rows.append("")          # diff row (any content)
+        # unparseable content where a sequence row is expected keeps the
+        # phase (flush() still length-checks ref vs qry)
+        rows.append(rm.group(1) if rm else "")
     flush()
     return out
 

@@ -52,6 +52,25 @@ class TestParse:
         assert len(alns[2].rseq) == 90
         assert len(alns[2].qseq) == 90
 
+    def test_blank_diff_row_keeps_phase(self):
+        # a fully matching chunk can render its diff row with NO markers
+        # (whitespace-only); it must still occupy the diff slot, or the
+        # qry row of that chunk parses as the next chunk's ref row
+        text = """\
+     1      1 n   [     0..    12] x [     1..    13]  ~   0.0%
+
+         0 acgtacgt
+{spaces}
+         1 acgtacgt
+         8 acgt
+           ||||
+         9 acgt
+""".format(spaces=" " * 11)
+        alns = parse_lashow(io.StringIO(text))
+        assert len(alns) == 1
+        assert alns[0].rseq == "acgtacgtacgt"
+        assert alns[0].qseq == "acgtacgtacgt"
+
 
 class TestCigarScore:
     def test_aln2cigar(self):

@@ -37,6 +37,8 @@ from proovread_tpu.pipeline.dcorrect import (
     device_revcomp)
 from proovread_tpu.pipeline.masking import MaskParams, mask_batch
 
+pytestmark = pytest.mark.heavy
+
 
 PARAMS = AlignParams()
 
@@ -355,6 +357,23 @@ class TestDeviceSeed:
             found += bool(hit.any())
         assert found >= 0.9 * len(truth), f"recall {found}/{len(truth)}"
 
+    def test_slab_scan_matches_flat(self, monkeypatch):
+        """The scanned query-slab formulation of _probe (bounds program
+        size at config-3 scale) must be bitwise-equal to the flat one."""
+        lr, lengths, q, ql, truth = self._batch()
+        qj = jnp.asarray(q)
+        rc = device_revcomp(qj, jnp.asarray(ql))
+        index = dseed.device_index(jnp.asarray(lr), jnp.asarray(lengths),
+                                   PARAMS.min_seed_len)
+        flat = dseed.probe_candidates(index, qj, jnp.asarray(ql), rc, PARAMS,
+                                      stride=8, min_votes=2)
+        # a non-divisor slab exercises both the scan and the pad rows
+        monkeypatch.setattr(dseed, "PROBE_SLAB", 24)
+        scanned = dseed.probe_candidates(index, qj, jnp.asarray(ql), rc,
+                                         PARAMS, stride=8, min_votes=2)
+        for a, b in zip(flat, scanned):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_no_phantom_duplicates(self):
         """ADVICE round-2 high: a single exact placement must yield exactly
         one live candidate, not a duplicated cluster in a dead slot."""
@@ -578,6 +597,40 @@ class TestFusedIterations:
         np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
         np.testing.assert_array_equal(np.asarray(mask1), np.asarray(mask2))
         assert float(fracs[0]) == pytest.approx(float(frac1), abs=1e-6)
+
+
+class TestWindowCounts:
+    def test_matches_live_columns_oracle(self):
+        """The vectorized chimera window counts must equal the readable
+        per-candidate live_columns accumulation they replaced."""
+        from proovread_tpu.ops.encode import N_STATES
+        from proovread_tpu.pipeline.dcorrect import AlnData
+
+        rng = np.random.default_rng(5)
+        R, n = 12, 48
+        st = rng.integers(-1, 6, (R, n)).astype(np.int8)
+        qr = rng.integers(0, 90, (R, n)).astype(np.int16)
+        il = (rng.random((R, n)) < 0.2).astype(np.int16)
+        zi = np.zeros(R, np.int32)
+        aln = AlnData(
+            lread=zi, pos0=zi, span=np.full(R, n, np.int32),
+            admitted=np.ones(R, bool), vote_ok=np.ones(R, bool),
+            q_start=np.zeros(R, np.int32), q_end=np.full(R, 80, np.int32),
+            win_start=rng.integers(0, 40, R).astype(np.int32),
+            r_start=zi, r_end=np.full(R, n, np.int32),
+            cns=ConsensusParams(),
+            chunks=[(jnp.asarray(st), jnp.asarray(qr), jnp.asarray(il))],
+            chunk_size=R)
+        cis = np.arange(R)
+        for taboo_abs, (mat_from, Wn) in ((0, (20, 30)), (5, (0, 64))):
+            got = aln.window_counts(cis, taboo_abs, mat_from, Wn)
+            exp = np.zeros((Wn, N_STATES + 1))
+            for ci in cis:
+                col, stl, has_ins = aln.live_columns(int(ci), taboo_abs)
+                inw = (col >= mat_from) & (col < mat_from + Wn)
+                cls = np.where(has_ins, N_STATES, stl).astype(np.int64)
+                np.add.at(exp, (col[inw] - mat_from, cls[inw]), 1.0)
+            np.testing.assert_array_equal(got, exp)
 
 
 class TestScalarWalkKernels:

@@ -3,6 +3,7 @@ expansion path, and end-to-end FastCorrector accuracy."""
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from proovread_tpu.align.params import AlignParams
 from proovread_tpu.align.sw import ops_to_cigar, sw_batch
@@ -15,6 +16,8 @@ from proovread_tpu.ops import pileup as pileup_ops
 from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
 from proovread_tpu.ops.fused import fused_accumulate
 from proovread_tpu.pipeline import FastCorrector
+
+pytestmark = pytest.mark.heavy
 
 P = AlignParams()
 
