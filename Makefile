@@ -1,12 +1,18 @@
 # Convenience targets; the package itself needs no build step.
 
-.PHONY: test test-all bench
+.PHONY: smoke test test-all bench
 
-# fast regression loop (skips @slow end-to-end tests; target < 2 min)
+# smoke tier: logic + golden-parity tests, no interpret-mode Pallas
+# kernels — the edit loop (< 2 min on a single core)
+smoke:
+	python -m pytest tests/ -q -m 'not slow and not heavy'
+
+# regression tier: adds the interpret-mode kernel/device-engine suites
+# (~4 min on a multi-core box; the Pallas interpreter dominates on 1 core)
 test:
 	python -m pytest tests/ -q
 
-# the whole suite, slow end-to-end tests included
+# everything, incl. @slow end-to-end parity runs (nightly tier)
 test-all:
 	python -m pytest tests/ -q -m ''
 

@@ -130,6 +130,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = Config.load(args.cfg)
 
     outdir = args.pre
+    if args.debug:
+        # finish-pass admitted-alignment SAM dumps land next to the outputs
+        cfg.data["debug-dir"] = outdir
     os.makedirs(outdir, exist_ok=True)
     if os.listdir(outdir) and not args.overwrite:
         print(f"error: output dir {outdir!r} not empty "

@@ -21,6 +21,19 @@ import json
 import re
 from typing import Any, Dict, List, Optional
 
+def _bwa_def() -> Dict[str, Any]:
+    """The DEF mapper flags, derived from the AlignParams dataclass defaults
+    so there is exactly one source of truth (from_bwa_flags also falls back
+    to those defaults for any flag a user DEF override drops)."""
+    from proovread_tpu.align.params import AlignParams
+
+    p = AlignParams()
+    return {"-A": p.match, "-B": p.mismatch,
+            "-O": f"{p.o_del},{p.o_ins}", "-E": f"{p.e_del},{p.e_ins}",
+            "-L": p.clip, "-k": p.min_seed_len, "-w": p.band_width,
+            "-T": p.min_out_score, "-c": p.max_occ}
+
+
 # Built-in defaults. Semantic parity with proovread.cfg:105-302; values are
 # config parity (category b), not code.
 DEFAULTS: Dict[str, Any] = {
@@ -49,6 +62,10 @@ DEFAULTS: Dict[str, Any] = {
         "bam": ["read-long", "read-bam"],
         "utg": ["read-long", "ccs-1", "utg"],
         "utg-noccs": ["read-long", "utg"],
+        # 2014-publication schedule (proovread.cfg:140), SHRiMP2 params
+        # mapped onto the jax mapper ("shrimp-opt" below)
+        "legacy": ["read-long", "shrimp-pre-1", "shrimp-pre-2",
+                   "shrimp-pre-3", "shrimp-finish"],
     },
     "sr-coverage": {"DEF": 15,
                     "bwa-sr-finish": 30, "bwa-mr-finish": 30},
@@ -58,7 +75,7 @@ DEFAULTS: Dict[str, Any] = {
     "sr-indel-taboo-length": 7,
     "sr-indel-taboo": 0.1,
     "detect-chimera": {"DEF": 0, "bwa-sr-finish": 1, "bwa-mr-finish": 1,
-                       "read-sam": 1, "read-bam": 1},
+                       "shrimp-finish": 1, "read-sam": 1, "read-bam": 1},
     # phred-min,phred-max,mask-min-len,unmask-min-len,mask-reduce,end-ratio
     "hcr-mask": {"DEF": "20,41,80,130,60,0.7",
                  "bwa-sr-4": "20,41,80,130,60,0.3",
@@ -81,7 +98,42 @@ DEFAULTS: Dict[str, Any] = {
     "seq-filter": {"--trim-win": "12,5", "--min-length": 500},
     "chimera-filter": {"--min-score": 0.2, "--trim-length": 20},
     "siamaera": {},            # set to None to deactivate
-    "ccs": {"--min-subreads": 2},
+    "ccs": {"--min-subreads": 2, "--window": 512, "--overlap": 64,
+            "--batch-refs": 256},
+    # legacy-mode mapper schedule in SHRiMP2 gmapper flag form
+    # (proovread.cfg:386-461; resolved by align.params.from_shrimp_flags)
+    "shrimp-opt": {
+        "shrimp-pre-1": {"-h": "55%", "-s": "1" * 11, "-w": "130%",
+                         "--match": 5, "--mismatch": -11, "--open-r": -2,
+                         "--open-q": -1, "--ext-r": -4, "--ext-q": -3},
+        "shrimp-pre-2": {"-h": "55%", "-s": "1" * 10, "-w": "140%",
+                         "-r": "45%", "--match": 5, "--mismatch": -11,
+                         "--open-r": -2, "--open-q": -1, "--ext-r": -4,
+                         "--ext-q": -3},
+        "shrimp-pre-3": {"-h": "50%", "-s": "11111111,1111110000111111",
+                         "-w": "140%", "-r": "35%", "--match": 5,
+                         "--mismatch": -11, "--open-r": -2, "--open-q": -1,
+                         "--ext-r": -4, "--ext-q": -3},
+        "shrimp-pre-4": {"-h": "35%", "-s": "1111111,111101111",
+                         "-w": "150%", "-r": "25%", "--match": 5,
+                         "--mismatch": -11, "--open-r": -2, "--open-q": -1,
+                         "--ext-r": -4, "--ext-q": -3},
+        "shrimp-finish": {"-h": "90%", "-s": "1" * 20, "--match": 5,
+                          "--mismatch": -10, "--open-r": -5, "--open-q": -5,
+                          "--ext-r": -2, "--ext-q": -2},
+    },
+    # mapper schedules in bwa-proovread flag form (the cfg IS the mapper
+    # schedule, proovread.cfg:305-460): DEF merged with per-task overrides,
+    # -N counter stripping applies ("bwa-sr-3" -> "bwa-sr" -> DEF)
+    "bwa-opt": {
+        "DEF": _bwa_def(),
+        "bwa-sr-finish": {"-B": 13, "-O": "15,19", "-E": "3,3", "-k": 17,
+                          "-w": 30, "-T": 4.0},
+        "bwa-mr": {"-k": 13, "-T": 3.0},
+        "bwa-mr-1": {},
+        "bwa-mr-finish": {"-B": 13, "-O": "15,19", "-E": "3,3", "-k": 19,
+                          "-w": 30, "-T": 4.0},
+    },
     "lr-min-length": None,     # default: 2 x median sr length
     "utg-window": 512,         # unitig query windowing for the banded kernel
     "utg-overlap": 64,
@@ -90,6 +142,12 @@ DEFAULTS: Dict[str, Any] = {
     "batch-reads": 128,
     "device-chunk": 8192,
     "seed-stride": 8,
+    # device bytes allowed for the resident short-read set; larger sets
+    # stream per-pass slabs instead (driver._SrDevice)
+    "sr-device-budget": 2147483648,
+    # directory for the --debug admitted-alignment SAM dumps (set by the
+    # CLI to the output dir; bam2cns --debug's filtered-BAM role)
+    "debug-dir": None,
 }
 
 _COMMENT_RE = re.compile(r"^\s*//.*$", re.M)

@@ -274,6 +274,7 @@ class SamReader:
 
     def __init__(self, source: Union[str, _io.IOBase]):
         self._bam = False
+        self._path = source if isinstance(source, str) else None
         if isinstance(source, str):
             if _is_bam(source):
                 self._bam = True
@@ -354,39 +355,76 @@ class SamReader:
                 return
             (block_size,) = struct.unpack("<i", raw)
             data = fh.read(block_size)
-            (ref_id, pos, l_qname, mapq, _bin, n_cigar, flag, l_seq,
-             next_ref, next_pos, tlen) = struct.unpack_from("<iiBBHHHiiii",
-                                                            data, 0)
-            off = 32
-            qname = data[off:off + l_qname - 1].decode()
-            off += l_qname
-            cig_parts = []
-            for _ in range(n_cigar):
-                (w,) = struct.unpack_from("<I", data, off)
-                off += 4
-                cig_parts.append(f"{w >> 4}{_CIGAR_OPS[w & 0xF]}")
-            cigar = "".join(cig_parts) or "*"
-            nb = (l_seq + 1) // 2
-            seq_b = data[off:off + nb]
-            off += nb
-            seq = "".join(
-                _SEQ16[(seq_b[i // 2] >> (4 if i % 2 == 0 else 0)) & 0xF]
-                for i in range(l_seq)) or "*"
-            qual_b = data[off:off + l_seq]
-            off += l_seq
-            if l_seq and qual_b[0] != 0xFF:
-                qual = bytes(q + 33 for q in qual_b).decode("ascii")
+            yield _decode_bam_record(data, refs)
+
+    # -- indexed region access (the role of Sam/Parser.pm:386-417, which
+    # shells out to `samtools view <region>`) ----------------------------
+    def fetch(self, rname: str, start: int = 0,
+              end: Optional[int] = None) -> Iterator[SamAlignment]:
+        """Alignments overlapping ``rname:[start, end)`` via the ``.bai``
+        index (built by :func:`build_bai` or samtools index). BAM paths
+        only; raises if no index file is found."""
+        if not self._bam or not isinstance(self._path, str):
+            raise ValueError("fetch() needs a BAM file path")
+        bai = _find_bai(self._path)
+        if bai is None:
+            raise FileNotFoundError(
+                f"no .bai index for {self._path!r} (run build_bai() or "
+                "samtools index)")
+        refs = self._bam_refs
+        try:
+            ref_id = next(i for i, (n, _) in enumerate(refs) if n == rname)
+        except StopIteration:
+            return
+        if end is None:
+            end = refs[ref_id][1] or 1 << 29
+        if end <= start:
+            return
+        # cache the parsed index on the reader: region re-entry fetches
+        # once per wanted ref, and the .bai covers ALL refs
+        cache = getattr(self, "_bai_cache", None)
+        if cache is None or cache[0] != bai:
+            cache = (bai, _parse_bai(bai))
+            self._bai_cache = cache
+        bins, ioff = cache[1][ref_id]
+        min_off = 0
+        w = start >> 14
+        if ioff:
+            min_off = ioff[min(w, len(ioff) - 1)]
+        chunks = []
+        for b in _reg2bins(start, end):
+            for beg, cend in bins.get(b, ()):
+                if cend > min_off:
+                    chunks.append((max(beg, min_off), cend))
+        if not chunks:
+            return
+        chunks.sort()
+        merged = [list(chunks[0])]
+        for beg, cend in chunks[1:]:
+            if beg <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], cend)
             else:
-                qual = "*"
-            rec = SamAlignment(
-                qname=qname, flag=flag,
-                rname=refs[ref_id][0] if ref_id >= 0 else "*",
-                pos=pos, mapq=mapq, cigar=cigar,
-                rnext=(refs[next_ref][0] if next_ref >= 0 else "*"),
-                pnext=next_pos, tlen=tlen, seq=seq, qual=qual,
-            )
-            self._parse_bam_tags(data, off, rec)
-            yield rec
+                merged.append([beg, cend])
+        bz = BgzfReader(self._path)
+        try:
+            for beg, cend in merged:
+                bz.seek_virtual(beg)
+                while bz.tell_virtual() < cend:
+                    raw = bz.read(4)
+                    if len(raw) < 4:
+                        break
+                    (block_size,) = struct.unpack("<i", raw)
+                    data = bz.read(block_size)
+                    (r_id, pos) = struct.unpack_from("<ii", data, 0)
+                    if r_id != ref_id or pos >= end:
+                        if r_id > ref_id or (r_id == ref_id and pos >= end):
+                            break
+                        continue
+                    rec = _decode_bam_record(data, refs)
+                    if rec.pos + max(rec.ref_span, 1) > start:
+                        yield rec
+        finally:
+            bz.close()
 
     @staticmethod
     def _parse_bam_tags(data: bytes, off: int, rec: SamAlignment) -> None:
@@ -424,6 +462,238 @@ class SamReader:
                 rec.tags[tag] = ("B", (sub, vals))
             else:
                 raise ValueError(f"unknown BAM tag type {tc!r}")
+
+
+def _decode_bam_record(data: bytes,
+                       refs: List[Tuple[str, int]]) -> SamAlignment:
+    """One BAM alignment body (after the block_size field) -> record."""
+    (ref_id, pos, l_qname, mapq, _bin, n_cigar, flag, l_seq,
+     next_ref, next_pos, tlen) = struct.unpack_from("<iiBBHHHiiii", data, 0)
+    off = 32
+    qname = data[off:off + l_qname - 1].decode()
+    off += l_qname
+    cig_parts = []
+    for _ in range(n_cigar):
+        (w,) = struct.unpack_from("<I", data, off)
+        off += 4
+        cig_parts.append(f"{w >> 4}{_CIGAR_OPS[w & 0xF]}")
+    cigar = "".join(cig_parts) or "*"
+    nb = (l_seq + 1) // 2
+    seq_b = data[off:off + nb]
+    off += nb
+    seq = "".join(
+        _SEQ16[(seq_b[i // 2] >> (4 if i % 2 == 0 else 0)) & 0xF]
+        for i in range(l_seq)) or "*"
+    qual_b = data[off:off + l_seq]
+    off += l_seq
+    if l_seq and qual_b[0] != 0xFF:
+        qual = bytes(q + 33 for q in qual_b).decode("ascii")
+    else:
+        qual = "*"
+    rec = SamAlignment(
+        qname=qname, flag=flag,
+        rname=refs[ref_id][0] if ref_id >= 0 else "*",
+        pos=pos, mapq=mapq, cigar=cigar,
+        rnext=(refs[next_ref][0] if next_ref >= 0 else "*"),
+        pnext=next_pos, tlen=tlen, seq=seq, qual=qual,
+    )
+    SamReader._parse_bam_tags(data, off, rec)
+    return rec
+
+
+class BgzfReader:
+    """Random-access BGZF reader with htslib virtual offsets
+    (``(compressed_block_start << 16) | offset_within_block``)."""
+
+    def __init__(self, path: str):
+        self._fh = open(path, "rb")
+        self._coff = 0          # file offset of the loaded block
+        self._next = 0          # file offset of the following block
+        self._buf = b""
+        self._pos = 0
+
+    def _load_block(self, coff: int) -> bool:
+        fh = self._fh
+        fh.seek(coff)
+        hdr = fh.read(12)
+        if len(hdr) < 12:
+            self._coff, self._next = coff, coff
+            self._buf, self._pos = b"", 0
+            return False
+        if hdr[:2] != b"\x1f\x8b":
+            raise ValueError(f"not a BGZF block at offset {coff}")
+        (xlen,) = struct.unpack_from("<H", hdr, 10)
+        extra = fh.read(xlen)
+        bsize = None
+        o = 0
+        while o + 4 <= len(extra):
+            si1, si2, slen = extra[o], extra[o + 1], \
+                struct.unpack_from("<H", extra, o + 2)[0]
+            if si1 == 66 and si2 == 67 and slen == 2:
+                bsize = struct.unpack_from("<H", extra, o + 4)[0]
+            o += 4 + slen
+        if bsize is None:
+            raise ValueError(f"missing BGZF BC subfield at offset {coff}")
+        comp = fh.read(bsize + 1 - 12 - xlen - 8)
+        fh.read(8)                                   # crc32 + isize
+        self._buf = zlib.decompressobj(-15).decompress(comp)
+        self._coff = coff
+        self._next = coff + bsize + 1
+        self._pos = 0
+        return True
+
+    def _advance(self) -> bool:
+        """Load the next block when the current one is exhausted; False at
+        EOF (or the 28-byte empty EOF block, whose payload is empty)."""
+        while self._pos >= len(self._buf):
+            if not self._load_block(self._next):
+                return False
+        return True
+
+    def seek_virtual(self, voff: int) -> None:
+        self._load_block(voff >> 16)
+        self._pos = voff & 0xFFFF
+
+    def tell_virtual(self) -> int:
+        if self._pos >= len(self._buf):
+            if not self._advance():
+                return self._coff << 16
+        return (self._coff << 16) | self._pos
+
+    def read(self, n: int) -> bytes:
+        out = b""
+        while n > 0:
+            if not self._advance():
+                break
+            take = self._buf[self._pos:self._pos + n]
+            self._pos += len(take)
+            n -= len(take)
+            out += take
+        return out
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def _reg2bins(beg: int, end: int):
+    """All UCSC-binning bins overlapping [beg, end) (SAM spec 5.1.1)."""
+    end -= 1
+    yield 0
+    for shift, off in ((26, 1), (23, 9), (20, 73), (17, 585), (14, 4681)):
+        for k in range(off + (beg >> shift), off + (end >> shift) + 1):
+            yield k
+
+
+def _find_bai(path: str) -> Optional[str]:
+    import os
+    for cand in (path + ".bai", re.sub(r"\.bam$", ".bai", path)):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _parse_bai(path: str):
+    """[.bai] -> per-ref (bins: {bin: [(voff_beg, voff_end)]}, ioffsets)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if data[:4] != b"BAI\x01":
+        raise ValueError(f"{path!r} is not a BAI index")
+    (n_ref,) = struct.unpack_from("<i", data, 4)
+    off = 8
+    out = []
+    for _ in range(n_ref):
+        (n_bin,) = struct.unpack_from("<i", data, off)
+        off += 4
+        bins: Dict[int, list] = {}
+        for _ in range(n_bin):
+            b, n_chunk = struct.unpack_from("<Ii", data, off)
+            off += 8
+            chunks = []
+            for _ in range(n_chunk):
+                beg, cend = struct.unpack_from("<QQ", data, off)
+                off += 16
+                chunks.append((beg, cend))
+            if b != 37450:                           # metadata pseudo-bin
+                bins[b] = chunks
+        (n_intv,) = struct.unpack_from("<i", data, off)
+        off += 4
+        ioff = list(struct.unpack_from(f"<{n_intv}Q", data, off))
+        off += 8 * n_intv
+        out.append((bins, ioff))
+    return out
+
+
+def build_bai(bam_path: str, out_path: Optional[str] = None) -> str:
+    """Build a standard ``.bai`` index for a coordinate-sorted BAM — the
+    native stand-in for ``samtools index`` (the reference's region access,
+    ``Sam/Parser.pm:386-417``, assumes an indexed BAM)."""
+    bz = BgzfReader(bam_path)
+    if bz.read(4) != b"BAM\x01":
+        bz.close()
+        raise ValueError(f"{bam_path!r} is not a BAM file")
+    (l_text,) = struct.unpack("<i", bz.read(4))
+    bz.read(l_text)
+    (n_ref,) = struct.unpack("<i", bz.read(4))
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack("<i", bz.read(4))
+        bz.read(l_name + 4)
+
+    bins = [dict() for _ in range(n_ref)]            # bin -> [beg, end] list
+    ioffs = [dict() for _ in range(n_ref)]           # window -> min voff
+    prev_ref, prev_pos = -1, -1
+    while True:
+        voff = bz.tell_virtual()
+        raw = bz.read(4)
+        if len(raw) < 4:
+            break
+        (block_size,) = struct.unpack("<i", raw)
+        data = bz.read(block_size)
+        vend = bz.tell_virtual()
+        (ref_id, pos, l_qname, _mapq, _bin, n_cigar) = \
+            struct.unpack_from("<iiBBHH", data, 0)
+        if ref_id < 0:
+            continue
+        if ref_id < prev_ref or (ref_id == prev_ref and pos < prev_pos):
+            bz.close()
+            raise ValueError("BAM is not coordinate-sorted; cannot index")
+        prev_ref, prev_pos = ref_id, pos
+        span = 0
+        o = 32 + l_qname
+        for _ in range(n_cigar):
+            (w,) = struct.unpack_from("<I", data, o)
+            o += 4
+            if _CIGAR_OPS[w & 0xF] in "MDN=X":
+                span += w >> 4
+        end = pos + max(span, 1)
+        b = _reg2bin(pos, end)
+        blist = bins[ref_id].setdefault(b, [])
+        if blist and blist[-1][1] == voff:
+            blist[-1][1] = vend                      # coalesce adjacent
+        else:
+            blist.append([voff, vend])
+        for w in range(pos >> 14, ((end - 1) >> 14) + 1):
+            cur = ioffs[ref_id].get(w)
+            if cur is None or voff < cur:
+                ioffs[ref_id][w] = voff
+    bz.close()
+
+    out_path = out_path or bam_path + ".bai"
+    with open(out_path, "wb") as fh:
+        fh.write(b"BAI\x01" + struct.pack("<i", n_ref))
+        for r in range(n_ref):
+            fh.write(struct.pack("<i", len(bins[r])))
+            for b in sorted(bins[r]):
+                chunks = bins[r][b]
+                fh.write(struct.pack("<Ii", b, len(chunks)))
+                for beg, cend in chunks:
+                    fh.write(struct.pack("<QQ", beg, cend))
+            n_intv = (max(ioffs[r]) + 1) if ioffs[r] else 0
+            fh.write(struct.pack("<i", n_intv))
+            filled = 0
+            for w in range(n_intv):
+                filled = ioffs[r].get(w, filled)
+                fh.write(struct.pack("<Q", filled))
+    return out_path
 
 
 def _gzipped(path: str) -> bool:

@@ -83,8 +83,11 @@ def ccs_correct(
     window: int = 512,
     overlap: int = 64,
     batch_refs: int = 256,
+    min_subreads: int = 2,
 ) -> Tuple[List[SeqRecord], CcsStats]:
-    """Collapse multi-subread ZMWs to consensus reads, in input order."""
+    """Collapse multi-subread ZMWs to consensus reads, in input order.
+    Groups with fewer than ``min_subreads`` members pass through unconsensed
+    (ccs --min-subreads, proovread.cfg ``ccs`` block)."""
     stats = CcsStats()
 
     groups: Dict[str, List[int]] = {}
@@ -103,7 +106,7 @@ def ccs_correct(
     ref_of: Dict[str, int] = {}
     for z in order:
         g = groups[z]
-        if len(g) == 1:
+        if len(g) < max(min_subreads, 2):
             continue
         if len(g) == 2:
             ref = g[0] if len(records[g[0]]) > len(records[g[1]]) else g[1]
@@ -144,9 +147,11 @@ def ccs_correct(
     out: List[SeqRecord] = []
     for z in order:
         g = groups[z]
-        if len(g) == 1:
-            stats.single += 1
-            out.append(records[g[0]])
+        if z not in ref_of:
+            # singleton, or a multi-group below min_subreads: every member
+            # passes through unconsensed
+            stats.single += len(g)
+            out.extend(records[i] for i in g)
         else:
             stats.primary += 1
             stats.secondary += len(g) - 1

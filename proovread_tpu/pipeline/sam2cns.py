@@ -77,6 +77,29 @@ def _collect_blocks(alns_in: Iterable[SamAlignment], wanted: Dict[str, int],
     return out
 
 
+def _open_alns(source: Union[str, Iterable[SamAlignment]],
+               wanted: Dict[str, int]) -> Iterable[SamAlignment]:
+    """Alignment stream for a source. When the source is an INDEXED BAM
+    (``.bai`` present) and the wanted refs are a subset of the header's,
+    fetch each wanted reference's region instead of streaming the whole
+    file — the reference's region access (``Sam/Parser.pm:386-417``) for
+    re-entry on a read subset of a multi-GB mapping."""
+    if not isinstance(source, str):
+        return source
+    reader = SamReader(source)
+    from proovread_tpu.io.sam import _find_bai
+    if (getattr(reader, "_bam", False) and _find_bai(source)
+            and len(wanted) < len(reader.header.refs)):
+        def gen():
+            for rname in wanted:
+                if rname in reader.header.refs:
+                    yield from reader.fetch(rname)
+        log.info("sam2cns: .bai region fetch for %d of %d refs",
+                 len(wanted), len(reader.header.refs))
+        return gen()
+    return iter(reader)
+
+
 def sam2cns(
     source: Union[str, Iterable[SamAlignment]],
     refs: Sequence[SeqRecord],
@@ -91,13 +114,8 @@ def sam2cns(
     pileup columns; chunk ``refs`` externally (the reference's byte-offset
     chunking, ``bin/proovread:1547-1606``) to bound the former."""
     cfg = config or Sam2CnsConfig()
-    if isinstance(source, str):
-        reader = SamReader(source)
-        alns_in: Iterable[SamAlignment] = iter(reader)
-    else:
-        alns_in = source
-
     wanted = {r.id: i for i, r in enumerate(refs)}
+    alns_in = _open_alns(source, wanted)
     by_ref = _collect_blocks(alns_in, wanted, cfg.params.invert_scores)
 
     engine = ConsensusEngine(params=cfg.params)
@@ -141,6 +159,63 @@ def sam2cns(
             batch, alnsets, ignore_coords=ignore,
             detect_chimera=cfg.detect_chimera)
         yield from results
+
+
+def sam2cns_variants(
+    source: Union[str, Iterable[SamAlignment]],
+    refs: Sequence[SeqRecord],
+    config: Optional[Sam2CnsConfig] = None,
+    min_freq: float = 4.0,
+    min_prob: float = 0.0,
+    or_min: bool = False,
+    stabilize: bool = False,
+):
+    """Per-column variant tables instead of consensus — the
+    ``call_variants`` entry (Sam/Seq.pm:1666-1734; upstream's
+    --haplo-coverage branch computes exactly this before dying at
+    'haploc_consensus??', bin/bam2cns:426-432). Yields
+    (group_read_records, VariantTable) per ``max_ref_seqs`` batch; render
+    with ``ops.variants.variants_tsv``. Alignment-set filters are identical
+    to the consensus path; column-level ignore coords (MCRs, utg overlap
+    windows) do NOT apply — upstream ``call_variants`` re-inits the state
+    matrix without them (Sam/Seq.pm:1676-1677)."""
+    cfg = config or Sam2CnsConfig()
+    wanted = {r.id: i for i, r in enumerate(refs)}
+    alns_in = _open_alns(source, wanted)
+    by_ref = _collect_blocks(alns_in, wanted, cfg.params.invert_scores)
+
+    engine = ConsensusEngine(params=cfg.params)
+    for start in range(0, len(refs), cfg.max_ref_seqs):
+        group = refs[start:start + cfg.max_ref_seqs]
+        batch = pack_reads(group)
+        alnsets: List[AlnSet] = []
+        for j, ref in enumerate(group):
+            aset = AlnSet(ref_id=ref.id, ref_len=len(ref), params=cfg.params)
+            aset.alns.extend(by_ref.pop(start + j, ()))
+            # identical filter order to sam2cns() above, so the variant
+            # table is computed over exactly the consensus admission set
+            aset.filter_by_scores()
+            if cfg.utg_mode:
+                if cfg.params.rep_coverage:
+                    aset.filter_rep_region_alns()
+                aset.filter_contained_alns()
+                aset.admit(cap_coverage=False)
+            else:
+                aset.admit()
+                if cfg.params.rep_coverage:
+                    aset.filter_rep_region_alns()
+                if cfg.haplo_coverage is not None:
+                    aset.filter_by_coverage(cfg.haplo_coverage)
+            alnsets.append(aset)
+        table = engine.variant_table(
+            batch, alnsets, min_freq=min_freq, min_prob=min_prob,
+            or_min=or_min)
+        if stabilize:
+            # fix noise at SNPs with close indels (Sam/Seq.pm:1791:
+            # default min_freq 2, var_dist 4)
+            from proovread_tpu.ops.variants import stabilize_variants
+            stabilize_variants(table, alnsets, [r.seq for r in group])
+        yield group, table
 
 
 def sam2cns_records(

@@ -37,6 +37,29 @@ def _trim_params(cfg: Config) -> TrimParams:
     )
 
 
+def _align_schedule(cfg: Config, base: str):
+    """task -> AlignParams from the "bwa-opt" config key (DEF merged with
+    per-task overrides, -N counter stripping). The cfg IS the mapper
+    schedule, as in the reference (proovread.cfg:305-460)."""
+    import re as _re
+
+    from proovread_tpu.align.params import from_bwa_flags
+
+    bw = cfg.data.get("bwa-opt") or {}
+
+    def for_task(task: str):
+        flags = dict(bw.get("DEF", {}))
+        t = task if task in bw else _re.sub(r"-\d+$", "", task)
+        flags.update(bw.get(t, {}))
+        return from_bwa_flags(flags)
+
+    return {
+        "first": for_task(f"bwa-{base}-1"),
+        "rest": for_task(f"bwa-{base}-2"),
+        "finish": for_task(f"bwa-{base}-finish"),
+    }
+
+
 def _pipeline_config(cfg: Config, mode: str, tasks: Sequence[str],
                      coverage, lr_min_length, sampling,
                      haplo=None) -> PipelineConfig:
@@ -59,6 +82,10 @@ def _pipeline_config(cfg: Config, mode: str, tasks: Sequence[str],
             cfg.get("hcr-mask", late_task)),
         lr_min_length=lr_min_length,
         sampling=sampling,
+        sr_chunk_number=int(cfg.get("sr-chunk-number")),
+        sr_chunk_step=int(cfg.get("sr-chunk-step")),
+        sr_trim=bool(int(cfg.get("sr-trim"))),
+        align_schedule=_align_schedule(cfg, base),
         haplo_coverage=haplo,
         trim=_trim_params(cfg),
         indel_taboo_length=int(cfg.get("sr-indel-taboo-length")),
@@ -67,6 +94,8 @@ def _pipeline_config(cfg: Config, mode: str, tasks: Sequence[str],
         batch_reads=int(cfg.get("batch-reads")),
         device_chunk=int(cfg.get("device-chunk")),
         seed_stride=int(cfg.get("seed-stride")),
+        sr_device_budget=int(cfg.get("sr-device-budget")),
+        debug_dir=cfg.get("debug-dir"),
     )
 
 
@@ -116,7 +145,13 @@ def run_tasks(
                      "(-noccs fallback, bin/proovread:1512-1517)")
         else:
             t0 = time.time()
-            longs, st = ccs_correct(longs)
+            ccs_cfg = cfg.get("ccs") or {}
+            longs, st = ccs_correct(
+                longs,
+                min_subreads=int(ccs_cfg.get("--min-subreads", 2)),
+                window=int(ccs_cfg.get("--window", 512)),
+                overlap=int(ccs_cfg.get("--overlap", 64)),
+                batch_refs=int(ccs_cfg.get("--batch-refs", 256)))
             reports.append(TaskReport("ccs-1", 0.0, 0, st.primary))
             log.info("ccs-1: %d primary, %d single, %d secondary dropped "
                      "(%.1fs)", st.primary, st.single, st.secondary,
@@ -177,6 +212,34 @@ def run_tasks(
         log.info("utg: masked %.1f%% (%.1fs)", utg_rep.masked_frac * 100,
                  time.time() - t0)
         utg_corrected = True
+
+    # -- legacy mode: the 2014 SHRiMP2 schedule on the jax mapper --------
+    # (proovread.cfg:140 task list; per-iteration params from "shrimp-opt")
+    if any(t.startswith("shrimp-") for t in tasks):
+        if not shorts:
+            raise ValueError(f"mode {mode!r} needs -s/--short-reads input")
+        from proovread_tpu.align.params import from_shrimp_flags
+        so = cfg.data.get("shrimp-opt") or {}
+        pre = [t for t in tasks if t.startswith("shrimp-pre-")]
+        sched = {t.rsplit("-", 1)[1]: from_shrimp_flags(so.get(t, {}))
+                 for t in pre}
+        sched["finish"] = from_shrimp_flags(so.get("shrimp-finish", {}))
+        sched["first"] = sched.get("1", sched["finish"])
+        sched["rest"] = sched.get("2", sched["first"])
+        pc = _pipeline_config(cfg, "sr", tasks, coverage, lr_min_length,
+                              sampling, haplo=haplo_coverage)
+        pc.n_iterations = max(len(pre), 1)
+        pc.align_schedule = sched
+        pipe = Pipeline(pc)
+        result = pipe.run(longs, shorts)
+        # report task names in the legacy schedule's own vocabulary
+        for rep in result.reports:
+            rep.task = rep.task.replace("bwa-sr", "shrimp-pre") \
+                .replace("shrimp-pre-finish", "shrimp-finish")
+        result.reports = reports + result.reports
+        result.ignored = ignored0 + result.ignored
+        _apply_siamaera(cfg, result)
+        return result
 
     # -- iterated short-read correction ----------------------------------
     base = "mr" if mode.startswith("mr") else "sr"

@@ -59,17 +59,48 @@ def _write_fq(records, dest: Optional[str]) -> None:
 
 
 def sam2cns_tool(argv: List[str]) -> int:
-    """bin/sam2cns role: ``sam2cns <in.sam|in.bam> <ref.fq> [out.fq]``."""
+    """bin/sam2cns role: ``sam2cns [--variants [--stabilize]]
+    <in.sam|in.bam> <ref.fq> [out.fq|out.tsv]``. ``--variants`` emits the
+    per-column variant table (Sam::Seq::call_variants, Sam/Seq.pm:
+    1666-1734) instead of consensus; ``--stabilize`` re-calls close-variant
+    groups (stabilize_variants, :1777-1958)."""
+    variants = stabilize = False
+    while argv and argv[0] in ("--variants", "--stabilize"):
+        if argv[0] == "--variants":
+            variants = True
+        else:
+            stabilize = True
+        argv = argv[1:]
+    if stabilize and not variants:
+        print("sam2cns: --stabilize requires --variants", file=sys.stderr)
+        return 2
     if len(argv) < 2:
-        print("usage: python -m proovread_tpu.tools sam2cns "
-              "<in.sam|in.bam> <ref.fq|fa> [out.fq]", file=sys.stderr)
+        print("usage: python -m proovread_tpu.tools sam2cns [--variants] "
+              "<in.sam|in.bam> <ref.fq|fa> [out.fq|out.tsv]",
+              file=sys.stderr)
         return 2
     from proovread_tpu.consensus.params import ConsensusParams
     from proovread_tpu.pipeline.sam2cns import (Sam2CnsConfig,
-                                                sam2cns_records)
+                                                sam2cns_records,
+                                                sam2cns_variants)
     refs = _read_any(argv[1])
     cfg = Sam2CnsConfig(params=ConsensusParams(
         indel_taboo_length=7, use_ref_qual=True))
+    if variants:
+        from proovread_tpu.ops.variants import variants_tsv
+        fh = open(argv[2], "w") if len(argv) > 2 else sys.stdout
+        n_cols = 0
+        for group, table in sam2cns_variants(argv[0], refs, cfg,
+                                             stabilize=stabilize):
+            text = variants_tsv(table, [r.id for r in group],
+                                [len(r) for r in group])
+            fh.write(text)
+            n_cols += text.count("\n")
+        if len(argv) > 2:
+            fh.close()
+        print(f"sam2cns: variant table for {len(refs)} reads "
+              f"({n_cols} columns)", file=sys.stderr)
+        return 0
     out, chim = sam2cns_records(argv[0], refs, cfg)
     _write_fq(out, argv[2] if len(argv) > 2 else None)
     print(f"sam2cns: {len(out)} reads corrected, {len(chim)} chimera "
@@ -157,12 +188,26 @@ def dazz2sam_tool(argv: List[str]) -> int:
     return 0
 
 
+def bamindex_tool(argv: List[str]) -> int:
+    """``samtools index`` role: ``bamindex <in.bam> [out.bai]`` (native
+    .bai builder; Sam/Parser.pm:386-417 region access needs one)."""
+    if not argv:
+        print("usage: python -m proovread_tpu.tools bamindex "
+              "<in.bam> [out.bai]", file=sys.stderr)
+        return 2
+    from proovread_tpu.io.sam import build_bai
+    out = build_bai(argv[0], argv[1] if len(argv) > 1 else None)
+    print(f"bamindex: wrote {out}", file=sys.stderr)
+    return 0
+
+
 _TOOLS = {
     "samfilter": samfilter,
     "sam2cns": sam2cns_tool,
     "ccseq": ccseq_tool,
     "siamaera": siamaera_tool,
     "dazz2sam": dazz2sam_tool,
+    "bamindex": bamindex_tool,
 }
 
 

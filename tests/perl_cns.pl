@@ -15,12 +15,14 @@ my %o = (
     'trim' => 1, 'indel-taboo' => 0.1, 'indel-taboo-length' => 0,
     'max-coverage' => 50, 'bin-size' => 20, 'use-ref-qual' => 0,
     'qual-weighted' => 0, 'max-ins-length' => 0, 'fallback-phred' => 1,
-    'utg-mode' => 0,
+    'utg-mode' => 0, 'variants' => 0, 'min-freq' => 4, 'min-prob' => 0,
+    'or-min' => 0, 'stabilize' => 0,
 );
 GetOptions(\%o, 'sam=s', 'ref=s', 'trim=i', 'indel-taboo=f',
            'indel-taboo-length=i', 'max-coverage=i', 'bin-size=i',
            'use-ref-qual=i', 'qual-weighted=i', 'max-ins-length=i',
-           'fallback-phred=i', 'utg-mode=i') or die "bad options";
+           'fallback-phred=i', 'utg-mode=i', 'variants=i', 'min-freq=f',
+           'min-prob=f', 'or-min=i', 'stabilize=i') or die "bad options";
 
 Sam::Seq->Trim($o{'trim'});
 Sam::Seq->InDelTaboo($o{'indel-taboo'});
@@ -63,6 +65,23 @@ for my $id (@ids) {
     # utg mode: contained-alignment filter before consensus
     # (bin/bam2cns:398-422)
     $sso->filter_contained_alns if $o{'utg-mode'};
+    if ($o{'variants'}) {
+        # golden variant table: one TSV line per column -
+        # id, col, cov, vars (comma), freqs (comma)
+        $sso->call_variants(
+            min_freq => $o{'min-freq'},
+            min_prob => $o{'min-prob'},
+            or_min   => $o{'or-min'},
+        );
+        $sso->stabilize_variants if $o{'stabilize'};
+        for (my $i = 0; $i < $sso->len; $i++) {
+            my $cov  = $sso->{covs}[$i] // 0;
+            my $vars = join(",", @{$sso->{vars}[$i]});
+            my $freqs = join(",", @{$sso->{freqs}[$i]});
+            print "$id\t$i\t$cov\t$vars\t$freqs\n";
+        }
+        next;
+    }
     my $con = $sso->consensus(
         use_ref_qual  => $o{'use-ref-qual'},
         qual_weighted => $o{'qual-weighted'},

@@ -12,6 +12,8 @@ import pytest
 from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.pipeline.ccs import (ccs_correct, is_subread_set, zmw_of)
 
+pytestmark = pytest.mark.heavy
+
 BASES = "ACGT"
 
 
@@ -74,6 +76,21 @@ class TestCcsCorrect:
         after = _identity(out[0].seq, true)
         assert after > before, (before, after)
         assert after > 0.97
+
+    def test_min_subreads_gate_passes_group_through(self):
+        """--min-subreads above a group's size: the group passes through
+        unconsensed (all members), no crash (code-review r5 finding)."""
+        rng = np.random.default_rng(23)
+        t1 = "".join(BASES[i] for i in rng.integers(0, 4, 600))
+        t2 = "".join(BASES[i] for i in rng.integers(0, 4, 600))
+        pair = self._zmw(rng, t1, hole=3, n_subs=2)
+        trio = self._zmw(rng, t2, hole=4, n_subs=3)
+        out, stats = ccs_correct(pair + trio, min_subreads=3)
+        assert stats.primary == 1            # only the 3-subread group
+        assert stats.single == 2             # the pair passes through
+        ids = [r.id for r in out]
+        assert pair[0].id in ids and pair[1].id in ids
+        assert len(out) == 3
 
     def test_single_passthrough_and_mixed_order(self):
         rng = np.random.default_rng(22)

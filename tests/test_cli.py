@@ -25,6 +25,50 @@ class TestConfig:
         assert cfg.get("mask-shortcut-frac") == 0.92
         assert cfg.get("unknown-key", default="d") == "d"
 
+    def test_user_cfg_drives_mapper_schedule(self, tmp_path):
+        """A user cfg must reach the mapper schedule and sampler without
+        editing Python — the reference's 'cfg IS the pipeline definition'
+        contract (proovread.cfg:305-460)."""
+        from proovread_tpu.pipeline.tasks import (_align_schedule,
+                                                  _pipeline_config)
+        p = tmp_path / "user.cfg"
+        p.write_text('{"bwa-opt": {"DEF": {"-k": 15, "-T": 3.5}},'
+                     ' "sr-chunk-number": 50, "sr-chunk-step": 5,'
+                     ' "sr-trim": 0}')
+        cfg = Config.load(str(p))
+        sched = _align_schedule(cfg, "sr")
+        assert sched["rest"].min_seed_len == 15
+        assert sched["rest"].min_out_score == 3.5
+        # per-task overrides still layer on top of the user DEF
+        assert sched["finish"].min_seed_len == 17
+        pc = _pipeline_config(cfg, "sr", ["bwa-sr-1", "bwa-sr-finish"],
+                              None, None, True)
+        assert pc.sr_chunk_number == 50 and pc.sr_chunk_step == 5
+        assert pc.sr_trim is False
+        assert pc.align_schedule["rest"].min_seed_len == 15
+
+    def test_legacy_mode_schedule(self):
+        """legacy mode: the 2014 SHRiMP2 task list + flag mapping
+        (proovread.cfg:140,386-461)."""
+        from proovread_tpu.align.params import from_shrimp_flags
+        cfg = Config()
+        assert cfg.tasks("legacy") == [
+            "read-long", "shrimp-pre-1", "shrimp-pre-2", "shrimp-pre-3",
+            "shrimp-finish"]
+        so = cfg.data["shrimp-opt"]
+        p1 = from_shrimp_flags(so["shrimp-pre-1"])
+        assert p1.min_seed_len == 11
+        assert p1.min_out_score == pytest.approx(0.55 * 5)
+        assert (p1.match, p1.mismatch) == (5, 11)
+        assert (p1.o_del, p1.o_ins, p1.e_del, p1.e_ins) == (2, 1, 4, 3)
+        # spaced seeds reduce to the lightest seed's weight
+        p3 = from_shrimp_flags(so["shrimp-pre-3"])
+        assert p3.min_seed_len == 8
+        pf = from_shrimp_flags(so["shrimp-finish"])
+        assert pf.min_seed_len == 20
+        assert pf.min_out_score == pytest.approx(4.5)
+        assert (pf.o_del, pf.o_ins, pf.e_del, pf.e_ins) == (5, 5, 2, 2)
+
     def test_task_scoped_resolution(self):
         cfg = Config()
         assert cfg.get("sr-coverage") == 15
@@ -130,6 +174,7 @@ class TestCli:
         from proovread_tpu.cli import main
         assert main(["-l", "x.fq"]) == 2
 
+    @pytest.mark.heavy
     def test_end_to_end_sr(self, tmp_path):
         from proovread_tpu.cli import main
         lp, sp = _mk_inputs(tmp_path)
@@ -159,6 +204,7 @@ class TestCli:
         open(os.path.join(out, "existing"), "w").write("x")
         assert main(["-l", lp, "-s", sp, "-p", out]) == 2
 
+    @pytest.mark.heavy
     def test_sam_reentry_mode(self, tmp_path):
         """--sam re-entry: external mapping -> consensus -> outputs
         (read-sam task, bin/proovread:718-736)."""

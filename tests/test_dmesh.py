@@ -19,6 +19,8 @@ from proovread_tpu.pipeline.dcorrect import (DeviceCorrector,
                                              device_revcomp)
 from proovread_tpu.pipeline.masking import MaskParams
 
+pytestmark = pytest.mark.heavy
+
 BASES = "ACGT"
 Lp, M = 512, 128
 

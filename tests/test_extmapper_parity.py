@@ -1,0 +1,110 @@
+"""External-mapper golden parity (VERDICT r4 weak #7).
+
+The mapping layer's parity tests elsewhere use OUR mapper on both tracks;
+here a REAL external mapper from the reference toolchain — the vendored
+SHRiMP2 ``gmapper-ls`` binary (``/root/reference/util/shrimp-2.2.3``),
+driven with the reference's own shrimp-sr-1 parameter block
+(``proovread.cfg:307-312``) — produces the SAM, and the SAME file goes
+through (a) the reference Perl ``Sam::Seq`` engine (``tests/perl_cns.pl``)
+and (b) our ``sam2cns``. Real mapper output exercises CIGAR/score edge
+cases simulated alignments don't (leading insertions, clip mixes, repeat
+placements); acceptance is the BASELINE.json 0.1% bar.
+"""
+
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from proovread_tpu.consensus.params import ConsensusParams
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.pipeline.sam2cns import Sam2CnsConfig, sam2cns_records
+from tests.test_perl_parity import _identity, _run_perl
+
+GMAPPER = "/root/reference/util/shrimp-2.2.3/gmapper-ls"
+PERL = shutil.which("perl")
+
+pytestmark = [
+    pytest.mark.skipif(PERL is None, reason="perl not available"),
+    pytest.mark.skipif(not (os.path.exists(GMAPPER)
+                            and os.access(GMAPPER, os.X_OK)),
+                       reason="vendored gmapper-ls not available"),
+    pytest.mark.slow,
+]
+
+BASES = "ACGT"
+
+
+def _make_inputs(tmp_path, seed=42, glen=3000, lr_span=(200, 1400),
+                 err=0.09, n_sr=160):
+    rng = np.random.default_rng(seed)
+    genome = "".join(BASES[i] for i in rng.integers(0, 4, glen))
+    lr = []
+    a, b = lr_span
+    for c in genome[a:b]:
+        u = rng.random()
+        if u < err / 3:
+            continue                                  # deletion
+        if u < 2 * err / 3:
+            lr.append(BASES[int(rng.integers(0, 4))])  # insertion
+        if u < err:
+            lr.append(BASES[int(rng.integers(0, 4))])  # substitution
+        else:
+            lr.append(c)
+    long_read = "".join(lr)
+    ref_fa = tmp_path / "ref.fa"
+    ref_fa.write_text(f">lr0\n{long_read}\n")
+    reads_fa = tmp_path / "reads.fa"
+    with open(reads_fa, "w") as fh:
+        for i in range(n_sr):
+            st = int(rng.integers(a, b - 100))
+            fh.write(f">s{i}\n{genome[st:st + 100]}\n")
+    return genome[a:b], long_read, ref_fa, reads_fa
+
+
+def _run_gmapper(tmp_path, reads_fa, ref_fa):
+    """shrimp-sr-1 parameter block (proovread.cfg:307-312)."""
+    out = subprocess.run(
+        [GMAPPER, "-h", "45%", "--report", "200", "-w", "150%",
+         "-r", "40%", "--match", "5", "--mismatch", "-11",
+         "--open-r", "-2", "--open-q", "-1", "--ext-r", "-4",
+         "--ext-q", "-3", "-s", "1" * 10, "--no-mapping-qualities",
+         "-N", "1", "--sam", str(reads_fa), str(ref_fa)],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    sam = tmp_path / "gmapper.sam"
+    sam.write_text(out.stdout)
+    n_aln = sum(1 for ln in out.stdout.splitlines()
+                if ln and not ln.startswith("@"))
+    assert n_aln > 50, f"gmapper mapped only {n_aln} reads"
+    return sam
+
+
+def test_shrimp_sam_consensus_parity(tmp_path):
+    truth, long_read, ref_fa, reads_fa = _make_inputs(tmp_path)
+    sam = _run_gmapper(tmp_path, reads_fa, ref_fa)
+
+    ref_fq = tmp_path / "ref.fq"
+    ref_fq.write_text(f"@lr0\n{long_read}\n+\n{'&' * len(long_read)}\n")
+    perl = _run_perl(sam, ref_fq, indel_taboo_length=7, max_coverage=50,
+                     bin_size=20, use_ref_qual=1)
+    perl_seq = perl["lr0"][0].upper()
+
+    params = ConsensusParams(indel_taboo_length=7, max_coverage=50,
+                             bin_size=20, use_ref_qual=True)
+    refs = [SeqRecord("lr0", long_read,
+                      qual=np.full(len(long_read), 5, np.uint8))]
+    ours, _ = sam2cns_records(str(sam), refs,
+                              Sam2CnsConfig(params=params))
+    our_seq = ours[0].seq.upper()
+
+    # both engines converge toward the truth on external-mapper input
+    assert _identity(perl_seq, truth) > 0.95
+    assert _identity(our_seq, truth) > 0.95
+    dis = 1.0 - _identity(our_seq, perl_seq)
+    assert dis <= 0.001, (
+        f"external-mapper consensus disagreement {dis:.4%} "
+        f"(ours {len(our_seq)}bp, perl {len(perl_seq)}bp)")

@@ -15,6 +15,8 @@ from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
 from proovread_tpu.pipeline import Pipeline, PipelineConfig
 
+pytestmark = pytest.mark.heavy
+
 
 def _make_case(seed=0, L=600, snp_every=60, cov_a=8, cov_b=30):
     rng = np.random.default_rng(seed)

@@ -209,6 +209,176 @@ def test_parity_utg_mode(tmp_path):
         f"(reference self-spread {spread:.4%})")
 
 
+def _run_perl_variants(sam_path, ref_path, **knobs):
+    args = [PERL, str(DRIVER), "--sam", str(sam_path), "--ref",
+            str(ref_path), "--variants", "1"]
+    for k, v in knobs.items():
+        args += [f"--{k.replace('_', '-')}", str(v)]
+    out = subprocess.run(args, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = {}
+    for line in out.stdout.splitlines():
+        rid, col, cov, vars_s, freqs_s = line.split("\t")
+        vars_l = vars_s.split(",") if vars_s else []
+        freqs_l = ([float(x) for x in freqs_s.split(",")]
+                   if freqs_s.strip(",") else [])
+        rows[(rid, int(col))] = (float(cov), vars_l, freqs_l)
+    return rows
+
+
+@pytest.mark.parametrize("min_freq,min_prob,or_min",
+                         [(4, 0, 0), (3, 0.2, 1)])
+def test_variants_parity_vs_perl(tmp_path, min_freq, min_prob, or_min):
+    """Sam::Seq::call_variants golden parity (Sam/Seq.pm:1666-1734): same
+    SAM input through the Perl engine's variant table and ours. Coverage
+    must match on every column; the kept (state, freq) set must match on
+    all columns not involving composite insertion states (which the dense
+    pileup merges by match base — the documented deviation), at the 0.1%
+    disagreement bar. The second param set is the --haplo-coverage branch's
+    call (min_prob .2, min_freq 3, or_min, bin/bam2cns:427-431)."""
+    rng = np.random.default_rng(3)
+    truth, long_read, sam_lines = _simulate(rng)
+    sam_path = tmp_path / "in.sam"
+    sam_path.write_text("".join(ln + "\n" for ln in sam_lines))
+    ref_path = tmp_path / "ref.fq"
+    ref_path.write_text(f"@lr0\n{long_read}\n+\n{'&' * len(long_read)}\n")
+
+    knobs = dict(indel_taboo_length=7, max_coverage=50, bin_size=20,
+                 min_freq=min_freq, min_prob=min_prob, or_min=or_min)
+    perl = _run_perl_variants(sam_path, ref_path, **knobs)
+
+    from proovread_tpu.pipeline.sam2cns import sam2cns_variants
+    params = ConsensusParams(indel_taboo_length=7, max_coverage=50,
+                             bin_size=20)
+    refs = [SeqRecord("lr0", long_read,
+                      qual=np.full(len(long_read), 5, np.uint8))]
+    (group, table), = sam2cns_variants(
+        str(sam_path), refs, Sam2CnsConfig(params=params),
+        min_freq=min_freq, min_prob=min_prob, or_min=bool(or_min))
+
+    n_cols = len(long_read)
+    mism = comp = 0
+    for col in range(n_cols):
+        cov_p, vars_p, freqs_p = perl[("lr0", col)]
+        cov_o = float(table.covs[0, col])
+        kept_o = table.states_of(0, col)
+        if abs(cov_p - cov_o) > 1e-6:
+            mism += 1
+            continue
+        if cov_p == 0:
+            # '?' for never-touched columns; a vivified-but-empty matrix
+            # column prints empty vars — either way we keep nothing
+            assert vars_p in (["?"], []) and not kept_o
+            continue
+        if (any(len(v) != 1 for v in vars_p)
+                or any(len(s) != 1 for s, _ in kept_o)):
+            comp += 1                      # composite state: deviation zone
+            continue
+        set_p = sorted(zip(vars_p, [round(f) for f in freqs_p]))
+        set_o = sorted((s, round(f)) for s, f in kept_o)
+        if set_p != set_o:
+            mism += 1
+    assert mism <= max(1, 0.001 * n_cols), (
+        f"variant-table disagreement {mism}/{n_cols} cols "
+        f"({comp} composite cols excluded)")
+    # the deviation zone must stay a sliver, not swallow the comparison
+    assert comp < 0.05 * n_cols, f"{comp} composite columns of {n_cols}"
+
+
+def _two_hap_fixture(rng, L=1200, n_sr=400):
+    """Long read = haplotype A; half the short reads carry haplotype B
+    (two close SNPs + a 2bp deletion), forming one close-variant group —
+    the stabilize_variants target case (Sam/Seq.pm:1777-1958)."""
+    ref = "".join(BASES[i] for i in rng.integers(0, 4, L))
+
+    def snp(c):
+        return BASES[(BASES.index(c) + 1) % 4]
+
+    sam_lines = []
+    for i in range(n_sr):
+        st = int(rng.integers(0, L - 110))
+        if st in (407, 408):
+            st = 410
+        if i % 2 == 0:
+            seq = ref[st:st + 100]
+            cigar = "100M"
+            score = 5 * 100
+        else:
+            chars, ops = [], []
+            pos = st
+            while len(chars) < 100 and pos < L:
+                if pos in (400, 403):
+                    chars.append(snp(ref[pos]))
+                    ops.append("M")
+                elif pos in (407, 408):
+                    ops.append("D")
+                else:
+                    chars.append(ref[pos])
+                    ops.append("M")
+                pos += 1
+            while ops and ops[-1] == "D":
+                ops.pop()
+            seq = "".join(chars)
+            parts = []
+            k = 0
+            while k < len(ops):
+                j = k
+                while j < len(ops) and ops[j] == ops[k]:
+                    j += 1
+                parts.append(f"{j - k}{ops[k]}")
+                k = j
+            cigar = "".join(parts)
+            n_mm = sum(1 for p in (400, 403) if st <= p < pos)
+            score = 5 * (len(seq) - n_mm) - 11 * n_mm
+        sam_lines.append("\t".join([
+            f"s{i}", "0", "lr0", str(st + 1), "60", cigar, "*", "0", "0",
+            seq, "I" * len(seq), f"AS:i:{score}"]))
+    return ref, sam_lines
+
+
+def test_stabilize_variants_parity_vs_perl(tmp_path):
+    """stabilize_variants golden parity: the close-variant group (two SNPs
+    + deletion within var_dist) must be re-called as whole-group variant
+    strings identically by both engines — group coordinates, kept strings,
+    freqs and the '-' placeholder columns."""
+    rng = np.random.default_rng(8)
+    ref, sam_lines = _two_hap_fixture(rng)
+    sam_path = tmp_path / "in.sam"
+    sam_path.write_text("".join(ln + "\n" for ln in sam_lines))
+    ref_path = tmp_path / "ref.fq"
+    ref_path.write_text(f"@lr0\n{ref}\n+\n{'&' * len(ref)}\n")
+
+    knobs = dict(indel_taboo_length=7, max_coverage=50, bin_size=20,
+                 min_freq=4, stabilize=1)
+    perl = _run_perl_variants(sam_path, ref_path, **knobs)
+
+    from proovread_tpu.pipeline.sam2cns import sam2cns_variants
+    params = ConsensusParams(indel_taboo_length=7, max_coverage=50,
+                             bin_size=20)
+    refs = [SeqRecord("lr0", ref, qual=np.full(len(ref), 5, np.uint8))]
+    (group, table), = sam2cns_variants(
+        str(sam_path), refs, Sam2CnsConfig(params=params),
+        min_freq=4, stabilize=True)
+
+    assert table.stabilized and table.stabilized[0], "no group stabilized"
+    g = table.stabilized[0][0]
+    assert g.start == 400 and g.length == 9
+    # both haplotype strings survive with sane freqs
+    assert len(g.vars) == 2
+    hapA = ref[400:409]
+    assert hapA in g.vars
+    assert all(f >= 4 for f in g.freqs)
+
+    # Perl's table at the group columns must match ours exactly
+    cov_p, vars_p, freqs_p = perl[("lr0", 400)]
+    assert sorted(zip(vars_p, freqs_p)) == \
+        sorted(zip(g.vars, g.freqs)), (vars_p, freqs_p, g)
+    assert cov_p == g.cov
+    for col in range(401, 409):
+        cov_c, vars_c, freqs_c = perl[("lr0", col)]
+        assert vars_c == ["-"] and cov_c == g.cov
+
+
 def test_parity_sparse_coverage(tmp_path):
     """Low coverage leaves uncorrected stretches — both engines must agree
     on where correction happens, not just on the corrected value."""

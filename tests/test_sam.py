@@ -118,6 +118,50 @@ class TestSamIO:
         (got,) = list(SamReader(p))
         assert got.qual == "*" and got.seq == "ACGT"
 
+    def test_bai_build_and_fetch(self, tmp_path):
+        """build_bai + SamReader.fetch: the native samtools-index/region
+        stand-in (Sam/Parser.pm:386-417). Fetch over every window must
+        equal a full-scan overlap filter — including records spanning
+        BGZF block boundaries (the record stream deliberately exceeds one
+        64k block)."""
+        rng = np.random.default_rng(11)
+        hdr = SamHeader()
+        hdr.add_ref("c1", 120000)
+        hdr.add_ref("c2", 50000)
+        p = str(tmp_path / "big.bam")
+        recs = []
+        for rname, rlen in (("c1", 120000), ("c2", 50000)):
+            poss = np.sort(rng.integers(0, rlen - 600, 400))
+            for k, pos in enumerate(poss):
+                ln = int(rng.integers(80, 600))
+                seq = "".join("ACGT"[i] for i in
+                              rng.integers(0, 4, ln))
+                recs.append(SamAlignment(
+                    qname=f"{rname}_{k}", rname=rname, pos=int(pos),
+                    cigar=f"{ln}M", seq=seq, qual="I" * ln))
+        with BamWriter(p, hdr) as w:
+            for r in recs:
+                w.write(r)
+        from proovread_tpu.io.sam import build_bai
+        bai = build_bai(p)
+        assert bai == p + ".bai"
+
+        rd = SamReader(p)
+        for rname, start, end in (("c1", 0, 120000), ("c1", 30000, 31000),
+                                  ("c2", 0, 100), ("c2", 49000, 50000),
+                                  ("c1", 119000, 120000)):
+            got = [(a.qname, a.pos) for a in rd.fetch(rname, start, end)]
+            want = [(a.qname, a.pos) for a in recs
+                    if a.rname == rname and a.pos < end
+                    and a.pos + a.ref_span > start]
+            assert got == want, (rname, start, end, len(got), len(want))
+        # unknown ref yields nothing; missing index raises
+        assert list(rd.fetch("nope", 0, 100)) == []
+        import os
+        os.remove(bai)
+        with pytest.raises(FileNotFoundError):
+            next(rd.fetch("c1", 0, 100))
+
     def test_gzip_sam(self, tmp_path):
         import gzip
         p = str(tmp_path / "x.sam.gz")
@@ -246,6 +290,37 @@ class TestSam2Cns:
         out, chim = sam2cns_records(p, refs, cfg)
         assert len(out) == 1
         assert out[0].seq[80].upper() == true[80]
+
+    def test_variants_table_and_tool(self, tmp_path, capsys):
+        """sam2cns --variants: the call_variants entry (Sam/Seq.pm:1666-1734)
+        over the same SAM — the corrected column must show the truth base as
+        top variant, and the CLI writes the TSV."""
+        ref, true, text = self._sam_text_consensus()
+        p = str(tmp_path / "in.sam")
+        with open(p, "w") as fh:
+            fh.write("@SQ\tSN:lr\tLN:%d\n" % len(ref))
+            fh.write(text)
+        refs = [SeqRecord("lr", ref, qual=np.full(len(ref), 5, np.uint8))]
+        cfg = Sam2CnsConfig(params=ConsensusParams(indel_taboo_length=7))
+        from proovread_tpu.pipeline.sam2cns import sam2cns_variants
+        (group, table), = sam2cns_variants(p, refs, cfg)
+        kept = table.states_of(0, 80)
+        assert kept and kept[0][0] == true[80]
+        assert table.covs[0, 80] >= 4
+
+        # CLI: writes one TSV line per column
+        from proovread_tpu import tools
+        fq = str(tmp_path / "ref.fq")
+        with open(fq, "w") as fh:
+            qual = "&" * len(ref)
+            fh.write(f"@lr\n{ref}\n+\n{qual}\n")
+        out_tsv = str(tmp_path / "vars.tsv")
+        assert tools.sam2cns_tool(["--variants", p, fq, out_tsv]) == 0
+        lines = open(out_tsv).read().splitlines()
+        assert len(lines) == len(ref)
+        rid, col, cov, vars_s, freqs_s = lines[80].split("\t")
+        assert rid == "lr" and int(col) == 80
+        assert vars_s.split(",")[0] == true[80]
 
     def test_unmapped_ref_passthrough(self, tmp_path):
         p = str(tmp_path / "empty.sam")

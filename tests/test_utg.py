@@ -9,6 +9,8 @@ from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.pipeline.tasks import run_tasks
 from proovread_tpu.pipeline.utg import utg_correct
 
+pytestmark = pytest.mark.heavy
+
 BASES = "ACGT"
 
 
