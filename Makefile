@@ -8,7 +8,7 @@ smoke:
 	python -m pytest tests/ -q -m 'not slow and not heavy'
 
 # regression tier: adds the interpret-mode kernel/device-engine suites
-# (~4 min on a multi-core box; the Pallas interpreter dominates on 1 core)
+# (~10 min on a multi-core box; the Pallas interpreter dominates on 1 core)
 test:
 	python -m pytest tests/ -q
 

@@ -213,6 +213,9 @@ def main():
     # persistent compile cache: steady-state numbers, not XLA compile time
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # name every compile on stderr: when the tunneled compile helper dies,
+    # the log shows WHICH program killed it
+    jax.config.update("jax_log_compiles", True)
 
     try:
         out = _bench_config(args.config)

@@ -93,13 +93,28 @@ def pileup_accumulate_packed(
     )(read_of, w0, pileup_packed, words3)
 
 
+def _decode_bit_slab(b0_ref, b1_ref, n, rb):
+    """Vote bitmask planes -> bf16 one-hot slab [rb, n, 2*PACK_LANES] via
+    broadcast+shift (no per-lane compares). Per-plane expansion keeps the
+    intermediate at [rb, n, 32] i32 — a single wide shift would cost
+    ~6.5MB of the scoped-VMEM budget the row-resident accumulator needs."""
+    b0 = b0_ref[...][:, :, None]                      # [rb, n, 1]
+    b1 = b1_ref[...][:, :, None]
+    P2 = 2 * PACK_LANES
+    lane32 = jax.lax.broadcasted_iota(jnp.int32, (rb, n, 32), 2)
+    v0 = ((jnp.broadcast_to(b0, (rb, n, 32)) >> lane32) & 1)
+    v1 = ((jnp.broadcast_to(b1, (rb, n, 32)) >> lane32) & 1)
+    return jnp.concatenate(
+        [v0.astype(jnp.bfloat16), v1.astype(jnp.bfloat16),
+         jnp.zeros((rb, n, P2 - 64), jnp.bfloat16)], axis=2)
+
+
 def _accum_bits_kernel(read_of_ref, w0_ref, pile_in_ref, b0_ref, b1_ref,
                        pile_out_ref, acc_ref, rcur_ref, sem, *, n, rb):
-    """RB candidates per grid step: the vote bitmask planes expand to the
-    one-hot slab with broadcast+shift (no per-lane compares), and each
-    candidate's slab adds into the target read's pileup row held in a VMEM
-    accumulator, DMA-flushed at read boundaries (the read index lives in
-    SMEM across programs — the sequential grid guarantees ordering)."""
+    """RB candidates per grid step: each candidate's decoded slab adds into
+    the target read's pileup row held in a VMEM accumulator, DMA-flushed at
+    read boundaries (the read index lives in SMEM across programs — the
+    sequential grid guarantees ordering)."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -110,18 +125,7 @@ def _accum_bits_kernel(read_of_ref, w0_ref, pile_in_ref, b0_ref, b1_ref,
         ld.start()
         ld.wait()
 
-    b0 = b0_ref[...][:, :, None]                      # [rb, n, 1]
-    b1 = b1_ref[...][:, :, None]
-    P2 = 2 * PACK_LANES
-    lane32 = jax.lax.broadcasted_iota(jnp.int32, (rb, n, 32), 2)
-    # per-plane expansion in bf16: the [rb, n, 128] i32 intermediate of a
-    # single wide shift would cost ~6.5MB of the scoped-VMEM budget that
-    # long-read buckets need for the accumulator
-    v0 = ((jnp.broadcast_to(b0, (rb, n, 32)) >> lane32) & 1)
-    v1 = ((jnp.broadcast_to(b1, (rb, n, 32)) >> lane32) & 1)
-    vf = jnp.concatenate(
-        [v0.astype(jnp.bfloat16), v1.astype(jnp.bfloat16),
-         jnp.zeros((rb, n, P2 - 64), jnp.bfloat16)], axis=2)
+    vf = _decode_bit_slab(b0_ref, b1_ref, n, rb)
 
     for k in range(rb):
         g = i * rb + k
@@ -150,6 +154,38 @@ def _accum_bits_kernel(read_of_ref, w0_ref, pile_in_ref, b0_ref, b1_ref,
 
 
 PILEUP_BLOCK = 64
+
+# whole-row VMEM accumulator budget: a [Lp, 128] bf16 row beyond this
+# switches to the windowed-DMA kernel (the 32k+ read buckets' accumulator
+# plus the decode slabs exceeded scoped VMEM and killed the TPU compile)
+ACC_VMEM_BUDGET = 6 << 20
+
+
+def _accum_bits_win_kernel(read_of_ref, w0_ref, pile_in_ref, b0_ref, b1_ref,
+                           pile_out_ref, win_ref, sem, *, n, rb):
+    """Long-read variant of :func:`_accum_bits_kernel`: instead of holding
+    a whole pileup row in VMEM, each candidate DMA-loads only its (n, P)
+    window slice, adds its decoded slab, and stores it back. The TPU grid
+    is sequential, so overlapping windows of consecutive candidates never
+    race. ~2 window DMAs per candidate — slower than the row-resident
+    kernel, used only where the row no longer fits VMEM."""
+    i = pl.program_id(0)
+
+    vf = _decode_bit_slab(b0_ref, b1_ref, n, rb)
+
+    for k in range(rb):
+        g = i * rb + k
+        rd = read_of_ref[g]
+        w0 = pl.multiple_of(w0_ref[g], 16)
+        ld = pltpu.make_async_copy(
+            pile_out_ref.at[rd, pl.ds(w0, n)], win_ref, sem)
+        ld.start()
+        ld.wait()
+        win_ref[...] += vf[k]
+        wr = pltpu.make_async_copy(
+            win_ref, pile_out_ref.at[rd, pl.ds(w0, n)], sem)
+        wr.start()
+        wr.wait()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -182,6 +218,31 @@ def pileup_accumulate_bits(
     assert R % rb == 0, (R, rb)
 
     grid = (R // rb,)
+    if Lp * P * 2 > ACC_VMEM_BUDGET:
+        kernel = functools.partial(_accum_bits_win_kernel, n=n, rb=rb)
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec((rb, n), lambda i, ro, w: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((rb, n), lambda i, ro, w: (i, 0),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[
+                    pltpu.VMEM((n, P), jnp.bfloat16),
+                    pltpu.SemaphoreType.DMA(()),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((B, Lp, P), jnp.bfloat16),
+            input_output_aliases={2: 0},
+            interpret=interpret,
+        )(read_of, w0, pileup_packed, bits0, bits1)
+
     kernel = functools.partial(_accum_bits_kernel, n=n, rb=rb)
     return pl.pallas_call(
         kernel,

@@ -26,6 +26,7 @@ from proovread_tpu.io.batch import ReadBatch, pack_reads
 from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.ops.encode import encode_ascii
 from proovread_tpu.pipeline.correct import FastCorrector
+from proovread_tpu.pipeline.dcorrect import _bucket_chunks
 from proovread_tpu.pipeline.masking import MaskParams, mask_batch
 from proovread_tpu.pipeline.sampling import CoverageSampler
 from proovread_tpu.pipeline.trim import TrimParams, trim_records
@@ -272,7 +273,11 @@ class Pipeline:
             t0 = _time.time()
             for gi, (pad, batch_recs) in enumerate(groups):
                 want = int(pad * (1 + cfg.length_slack)) + 128
-                Lp = max(512, -(-want // 512) * 512)
+                # Lp on a {2^k, 3*2^(k-1)} ladder: every distinct Lp is a
+                # fresh compile of the whole per-bucket program stack, and
+                # real length spreads otherwise produce many shapes within
+                # ~10% of each other (config 3: 5 shapes in 17.9k-20k)
+                Lp = 512 * _bucket_chunks(max(1, -(-want // 512)))
                 res_batch, chim = self._run_batch_device(
                     batch_recs, sr_dev, len(short_records), sampler,
                     coverage, min_sr_len, reports, Lp)
@@ -339,8 +344,7 @@ class Pipeline:
 
         # -- pass 1: eager, dynamic chunk count (learns the candidate
         # scale + drives bucketing for the fused remainder) ---------------
-        from proovread_tpu.pipeline.dcorrect import (_bucket_chunks,
-                                                     fused_iterations,
+        from proovread_tpu.pipeline.dcorrect import (fused_iterations,
                                                      mask_params_vec)
         from proovread_tpu.align import bsw as _bsw
 

@@ -599,6 +599,36 @@ class TestFusedIterations:
         assert float(fracs[0]) == pytest.approx(float(frac1), abs=1e-6)
 
 
+class TestWindowedPileupKernel:
+    def test_matches_row_resident_kernel(self, monkeypatch):
+        """The windowed-DMA long-read pileup variant must be bitwise-equal
+        to the row-resident accumulator kernel (which it replaces when a
+        [Lp, 128] bf16 row exceeds the VMEM budget)."""
+        from proovread_tpu.ops import pileup_kernel as pk
+
+        rng = np.random.default_rng(31)
+        B, Lp, n, R = 3, 768, 64, 128
+        P = 2 * pk.PACK_LANES
+        pile0 = jnp.zeros((B, Lp, P), jnp.bfloat16)
+        bits0 = jnp.asarray(rng.integers(0, 1 << 31, (R, n), np.int64)
+                            .astype(np.int32))
+        bits1 = jnp.asarray(rng.integers(0, 1 << 31, (R, n), np.int64)
+                            .astype(np.int32))
+        read_of = jnp.asarray(np.sort(rng.integers(0, B, R)).astype(np.int32))
+        w0 = jnp.asarray(
+            (rng.integers(0, (Lp - n) // 16, R) * 16).astype(np.int32))
+
+        row = pk.pileup_accumulate_bits(pile0, bits0, bits1, read_of, w0,
+                                        interpret=True)
+        pk.pileup_accumulate_bits.clear_cache()
+        monkeypatch.setattr(pk, "ACC_VMEM_BUDGET", 1)
+        win = pk.pileup_accumulate_bits(pile0, bits0, bits1, read_of, w0,
+                                        interpret=True)
+        pk.pileup_accumulate_bits.clear_cache()
+        np.testing.assert_array_equal(np.asarray(row, np.float32),
+                                      np.asarray(win, np.float32))
+
+
 class TestWindowCounts:
     def test_matches_live_columns_oracle(self):
         """The vectorized chimera window counts must equal the readable
