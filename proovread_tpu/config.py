@@ -139,7 +139,7 @@ DEFAULTS: Dict[str, Any] = {
     "utg-overlap": 64,
     # engine knobs (TPU additions; no reference counterpart)
     "engine": "device",
-    "batch-reads": 128,
+    "batch-reads": 256,
     "device-chunk": 8192,
     "seed-stride": 8,
     # device bytes allowed for the resident short-read set; larger sets

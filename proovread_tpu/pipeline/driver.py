@@ -63,7 +63,7 @@ class PipelineConfig:
     # keys 'first'/'rest'/'finish' -> AlignParams. None = built-in schedule.
     align_schedule: Optional[Dict[str, AlignParams]] = None
     trim: TrimParams = field(default_factory=TrimParams)
-    batch_reads: int = 128            # long reads per device batch
+    batch_reads: int = 256            # long reads per device batch
     indel_taboo_length: int = 7       # sr-indel-taboo-length
     coverage_scale: float = 0.75      # coverage-scale-factor (proovread.cfg:256)
     # engine selection: "device" = fully device-resident iteration loop
@@ -732,9 +732,12 @@ class Pipeline:
         return out, chim
 
 
-# batch-rows x padded-length budget for one device batch (~0.5M cells ~=
-# 2.1GB of packed pileup at 64 f32 lanes/cell)
-CELL_BUDGET = 128 * 4096
+# batch-rows x padded-length budget for one device batch. Each batch runs
+# its own iteration loop, and every pass probes the WHOLE sampled SR set —
+# so batch count, not batch size, dominates wall clock at scale (config 3
+# r5: 17 batches = 17 probe sweeps of 375k reads per pass). 2M cells =
+# ~536MB of packed bf16 pileup (128 lanes), ~3% of v5e HBM.
+CELL_BUDGET = 128 * 16384
 
 
 def _bucket_records(kept, batch_size: int,
