@@ -1,6 +1,6 @@
 # Convenience targets; the package itself needs no build step.
 
-.PHONY: smoke test test-all bench
+.PHONY: smoke test test-all test-faults bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -15,6 +15,12 @@ test:
 # everything, incl. @slow end-to-end parity runs (nightly tier)
 test-all:
 	python -m pytest tests/ -q -m ''
+
+# resilience tier: fault-injection suite — the degradation ladder and the
+# checkpoint/resume journal end-to-end on CPU with injected compile/OOM/
+# timeout faults (tier-1-safe; also part of `make test`)
+test-faults:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults
 
 bench:
 	python bench.py

@@ -66,6 +66,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "re-entry modes)")
     ap.add_argument("--no-sampling", action="store_true",
                     help="use all short reads every iteration")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume a crashed/killed run: completed buckets "
+                         "replay from <pre>/.proovread_ckpt and the rest "
+                         "compute; output is byte-identical to an "
+                         "uninterrupted run (docs/RESILIENCE.md)")
+    ap.add_argument("--no-checkpoint", action="store_true",
+                    help="disable the per-bucket checkpoint journal")
+    ap.add_argument("--bucket-timeout", type=float, metavar="SECONDS",
+                    help="soft wall-clock budget per length bucket; a "
+                         "breach counts as a device fault and demotes the "
+                         "bucket down the degradation ladder")
+    ap.add_argument("--no-ladder", action="store_true",
+                    help="fail fast on device faults instead of retrying "
+                         "buckets down the degradation ladder")
     ap.add_argument("--overwrite", action="store_true",
                     help="allow writing into a non-empty output dir")
     ap.add_argument("--keep-temporary-files", action="store_true")
@@ -134,10 +148,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         # finish-pass admitted-alignment SAM dumps land next to the outputs
         cfg.data["debug-dir"] = outdir
     os.makedirs(outdir, exist_ok=True)
-    if os.listdir(outdir) and not args.overwrite:
+    # --resume must be able to re-enter the interrupted run's output dir
+    if os.listdir(outdir) and not (args.overwrite or args.resume):
         print(f"error: output dir {outdir!r} not empty "
-              "(use --overwrite)", file=sys.stderr)
+              "(use --overwrite, or --resume to continue a crashed run)",
+              file=sys.stderr)
         return 2
+    # resilience knobs (pipeline/resilience.py): per-bucket checkpoints on
+    # by default — the journal is what makes --resume possible at all
+    if args.resume and args.no_checkpoint:
+        print("error: --resume needs the checkpoint journal; drop "
+              "--no-checkpoint", file=sys.stderr)
+        return 2
+    ckpt_dir = None
+    if not args.no_checkpoint:
+        ckpt_dir = os.path.join(outdir, ".proovread_ckpt")
+        cfg.data["checkpoint-dir"] = ckpt_dir
+    if args.resume:
+        cfg.data["resume"] = 1
+    if args.bucket_timeout is not None:
+        cfg.data["bucket-timeout"] = args.bucket_timeout
+    if args.no_ladder:
+        cfg.data["resilience-ladder"] = 0
     name = os.path.basename(outdir.rstrip("/")) or "proovread"
 
     t_start = time.time()
@@ -220,8 +252,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             fh.write(f"{rid}\t{f0}\t{t0}\t{s:.3f}\n")
 
     for rep in result.reports:
-        log.info("task %-16s masked/supported %5.1f%%  candidates %d",
-                 rep.task, rep.masked_frac * 100, rep.n_candidates)
+        if rep.note:
+            # resilience events (ladder demotions, journal replays) carry
+            # their full story in the note — degraded output is
+            # attributable from the task summary alone
+            log.info("task %-16s %s", rep.task, rep.note)
+            continue
+        sat = ""
+        if rep.n_dropped_cap or rep.n_dropped_cov:
+            sat = (f"  dropped {rep.n_dropped_cap} cap /"
+                   f" {rep.n_dropped_cov} cov")
+        log.info("task %-16s masked/supported %5.1f%%  candidates %d%s",
+                 rep.task, rep.masked_frac * 100, rep.n_candidates, sat)
+    # the journal's job is done once the final outputs are on disk — it
+    # duplicates every corrected read, which is real space at the 315 Mb
+    # scale. --keep-temporary-files preserves it (reference semantics).
+    if ckpt_dir and os.path.isdir(ckpt_dir) \
+            and not args.keep_temporary_files:
+        import shutil
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        log.info("checkpoint journal removed (outputs written; "
+                 "--keep-temporary-files preserves it)")
     log.info("done: %d corrected, %d trimmed, %d ignored, %d chimera "
              "(%.1fs)", len(result.untrimmed), len(result.trimmed),
              len(result.ignored), len(result.chimera),

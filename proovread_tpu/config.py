@@ -141,6 +141,9 @@ DEFAULTS: Dict[str, Any] = {
     "engine": "device",
     "batch-reads": 256,
     "device-chunk": 8192,
+    # candidates per host-path SW slab (engine="scan" and the resilience
+    # ladder's host-scan rung)
+    "host-chunk-rows": 4096,
     "seed-stride": 8,
     # device bytes allowed for the resident short-read set; larger sets
     # stream per-pass slabs instead (driver._SrDevice)
@@ -148,6 +151,21 @@ DEFAULTS: Dict[str, Any] = {
     # directory for the --debug admitted-alignment SAM dumps (set by the
     # CLI to the output dir; bam2cns --debug's filtered-BAM role)
     "debug-dir": None,
+    # -- resilience (pipeline/resilience.py; docs/RESILIENCE.md) ----------
+    # per-bucket checkpoint journal dir (the CLI points this at
+    # <out>/.proovread_ckpt unless --no-checkpoint); None disables
+    "checkpoint-dir": None,
+    # 1 = replay completed buckets from the journal (--resume)
+    "resume": 0,
+    # per-bucket soft wall-clock budget in seconds (null = no budget);
+    # a breach counts as a 'timeout' fault and demotes the bucket
+    "bucket-timeout": None,
+    # 1 = degradation ladder on device faults (fused -> eager ->
+    # chunk-halved -> host-scan); 0 = fail fast
+    "resilience-ladder": 1,
+    # fault-injection spec (testing/faults.py grammar, e.g.
+    # "compile@b0.p2;oom@b1"); null reads the PROOVREAD_FAULT env var
+    "fault-spec": None,
 }
 
 _COMMENT_RE = re.compile(r"^\s*//.*$", re.M)

@@ -160,6 +160,19 @@ PILEUP_BLOCK = 64
 # plus the decode slabs exceeded scoped VMEM and killed the TPU compile)
 ACC_VMEM_BUDGET = 6 << 20
 
+# resilience-ladder override (pipeline/resilience.py "chunk-halved" rung):
+# when set, pileup_accumulate_bits takes the windowed-DMA accumulator even
+# for rows that fit VMEM — the low-memory retry regime after a VMEM/OOM
+# fault. Read at TRACE time: the ladder always pairs the toggle with a
+# device_chunk change, whose new slab shapes force the retrace that makes
+# the flag take effect.
+_FORCE_WINDOWED = False
+
+
+def force_windowed(on: bool) -> None:
+    global _FORCE_WINDOWED
+    _FORCE_WINDOWED = bool(on)
+
 
 def _accum_bits_win_kernel(read_of_ref, w0_ref, pile_in_ref, b0_ref, b1_ref,
                            pile_out_ref, win_ref, sem, *, n, rb):
@@ -218,7 +231,7 @@ def pileup_accumulate_bits(
     assert R % rb == 0, (R, rb)
 
     grid = (R // rb,)
-    if Lp * P * 2 > ACC_VMEM_BUDGET:
+    if _FORCE_WINDOWED or Lp * P * 2 > ACC_VMEM_BUDGET:
         kernel = functools.partial(_accum_bits_win_kernel, n=n, rb=rb)
         return pl.pallas_call(
             kernel,

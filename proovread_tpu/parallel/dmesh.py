@@ -82,7 +82,7 @@ def sharded_iteration_step(
             sread, strand, lread, diag, R_need)
         n_cand = jnp.minimum(n_valid, R_need).astype(jnp.int32)
 
-        call, n_admitted, _, _, _ = _fused_pass_body(
+        call, n_admitted, _n_eligible, _, _, _ = _fused_pass_body(
             map_codes.reshape(-1), mask_cols.reshape(-1),
             codes, qual, lengths, qc, rcq, qq, qlen,
             sread, strand, lread, diag, n_cand,

@@ -6,6 +6,9 @@ from proovread_tpu.pipeline.driver import (
     Pipeline, PipelineConfig, PipelineResult, TaskReport,
 )
 from proovread_tpu.pipeline.masking import MaskParams, hcr_intervals, mask_batch
+from proovread_tpu.pipeline.resilience import (LADDER, CheckpointJournal,
+                                               LadderLevel, classify_fault,
+                                               soft_deadline)
 from proovread_tpu.pipeline.sampling import CoverageSampler
 from proovread_tpu.pipeline.sam2cns import (Sam2CnsConfig, sam2cns,
                                             sam2cns_records)
@@ -16,6 +19,8 @@ __all__ = [
     "FastCorrector", "CorrectionStats",
     "Pipeline", "PipelineConfig", "PipelineResult", "TaskReport",
     "MaskParams", "hcr_intervals", "mask_batch",
+    "LADDER", "LadderLevel", "CheckpointJournal", "classify_fault",
+    "soft_deadline",
     "CoverageSampler", "TrimParams", "trim_records",
     "Sam2CnsConfig", "sam2cns", "sam2cns_records",
     "run_tasks",

@@ -39,6 +39,11 @@ def _round_up(n: int, m: int) -> int:
 class CorrectionStats:
     n_candidates: int = 0
     n_admitted: int = 0
+    # saturation KPI: threshold-passed candidates with a positive ref span;
+    # eligible minus admitted is what the max_coverage bin-budget admission
+    # dropped (a silent cap must not read as "covered everything")
+    n_eligible: int = 0
+    n_dropped_cov: int = 0
 
 
 class FastCorrector:
@@ -145,8 +150,10 @@ class FastCorrector:
             admitted = admit_mask(
                 cand.lread, pos0, span, score, refs.lengths, cns, valid=passed
             )
+            n_eligible = int((passed & (span > 0)).sum())
         else:
             admitted = np.zeros(0, bool)
+            n_eligible = 0
 
         ignore = None
         if ignore_coords is not None:
@@ -212,7 +219,10 @@ class FastCorrector:
                 results, refs, queries, cand, chunks, admitted,
                 win_start, r_start, r_end, q_start, q_end, score)
 
-        return results, CorrectionStats(n_cand, int(admitted.sum()))
+        n_adm = int(admitted.sum())
+        return results, CorrectionStats(
+            n_cand, n_adm, n_eligible=n_eligible,
+            n_dropped_cov=max(0, n_eligible - n_adm))
 
     def _detect_chimera(self, results, refs, queries, cand, chunks, admitted,
                         win_start, r_start, r_end, q_start, q_end, score):

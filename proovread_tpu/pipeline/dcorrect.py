@@ -330,10 +330,16 @@ def _pileup_bf16_safe(cns: ConsensusParams) -> bool:
 
 @dataclass
 class DevicePassStats:
-    """``n_admitted`` may be a device scalar — fetch it together with the
-    iteration KPI to pay one RPC, not two."""
+    """``n_admitted``/``n_eligible`` may be device scalars — fetch them
+    together with the iteration KPI to pay one RPC, not two.
+
+    ``n_eligible`` counts candidates that passed the score threshold with a
+    positive reference span — the saturation-KPI numerator: eligible minus
+    admitted is what the ``max_coverage`` bin-budget admission dropped
+    (VERDICT r5 weak #5: a silent cap reads as "covered everything")."""
     n_candidates: int = 0
     n_admitted: object = 0
+    n_eligible: object = 0
 
 
 @dataclass
@@ -755,8 +761,9 @@ def _fused_pass_unrolled(map_flat, ignore_flat, codes, qual, lengths,
 
     call = call_consensus(pile, codes, cns.max_ins_length)
     n_admitted = admitted.sum()
+    n_eligible = (all_passed & (all_span > 0)).sum()
     if not collect:
-        return call, n_admitted, None, None, hpl
+        return call, n_admitted, n_eligible, None, None, hpl
     scalars = (
         lread[:R_tot], all_pos0, all_span, admitted,
         jnp.concatenate([c[0].q_start for c in chunks]),
@@ -769,7 +776,7 @@ def _fused_pass_unrolled(map_flat, ignore_flat, codes, qual, lengths,
     slabs = ([c[0].state for c in chunks],
              [c[0].qrow for c in chunks],
              [c[0].ins_len for c in chunks])
-    return call, n_admitted, scalars, slabs, hpl
+    return call, n_admitted, n_eligible, scalars, slabs, hpl
 
 
 def _fused_pass_scanned(map_flat, ignore_flat, codes, qual, lengths,
@@ -895,13 +902,14 @@ def _fused_pass_scanned(map_flat, ignore_flat, codes, qual, lengths,
 
     call = call_consensus(pile, codes, cns.max_ins_length)
     n_admitted = admitted.sum()
+    n_eligible = (flat(passed_s) & (flat(span_s) > 0)).sum()
     if not collect:
-        return call, n_admitted, None, None, hpl
+        return call, n_admitted, n_eligible, None, None, hpl
     scalars = (lread, flat(pos0_s), flat(span_s), admitted, flat(qs_s),
                flat(qe_s), flat(ws_s), flat(rs_s), flat(re_s),
                sread, strand, flat(score_s))
     slabs = (st_s, qr_s, il_s)
-    return call, n_admitted, scalars, slabs, hpl
+    return call, n_admitted, n_eligible, scalars, slabs, hpl
 
 
 def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
@@ -985,8 +993,12 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
         sread, strand, lread, diag = _pad_candidates(
             sread, strand, lread, diag, R_need)
         n_cand = jnp.minimum(n_valid, R_need).astype(jnp.int32)
+        # saturation KPI: candidates past the static chunk provisioning are
+        # silently truncated by the clamp above — count them so the cap
+        # never reads as "covered everything" (VERDICT r5 weak #5)
+        n_drop = jnp.maximum(n_valid - R_need, 0).astype(jnp.int32)
 
-        call, n_adm, _, _, _ = _fused_pass_body(
+        call, n_adm, n_elig, _, _, _ = _fused_pass_body(
             map_codes.reshape(-1), mask_cols.reshape(-1),
             codes, qual, lengths, qc, rcq, qq, qlen,
             sread, strand, lread, diag, n_cand,
@@ -997,7 +1009,8 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
         new_mask, frac = device_hcr_mask_dyn(new_qual, new_len,
                                              mask_pvs[it],
                                              interpret=interpret)
-        return new_codes, new_qual, new_len, new_mask, frac, n_cand, n_adm
+        return (new_codes, new_qual, new_len, new_mask, frac, n_cand,
+                n_adm, n_elig, n_drop)
 
     def cond(state):
         (_, _, _, _, _, _, it, done, *_rest) = state
@@ -1005,27 +1018,33 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
 
     def body(state):
         (codes, qual, lengths, mask_cols, frac_prev, _gain, it, done,
-         fracs, ncands, nadms) = state
+         fracs, ncands, nadms, neligs, ndrops) = state
         (codes, qual, lengths, mask_cols, frac, n_cand,
-         n_adm) = one_pass(codes, qual, lengths, mask_cols, it)
+         n_adm, n_elig, n_drop) = one_pass(codes, qual, lengths,
+                                           mask_cols, it)
         gain = frac - frac_prev
         done = (frac > shortcut_frac) | (gain < min_gain)
         fracs = fracs.at[it].set(frac)
         ncands = ncands.at[it].set(n_cand)
         nadms = nadms.at[it].set(n_adm)
+        neligs = neligs.at[it].set(n_elig)
+        ndrops = ndrops.at[it].set(n_drop)
         return (codes, qual, lengths, mask_cols, frac, gain, it + 1, done,
-                fracs, ncands, nadms)
+                fracs, ncands, nadms, neligs, ndrops)
 
     init = (codes, qual, lengths, mask_cols, frac_prev, jnp.float32(0),
             jnp.int32(0), jnp.bool_(False),
             jnp.full(n_rest, -1.0, jnp.float32),
             jnp.zeros(n_rest, jnp.int32),
+            jnp.zeros(n_rest, jnp.int32),
+            jnp.zeros(n_rest, jnp.int32),
             jnp.zeros(n_rest, jnp.int32))
     (codes, qual, lengths, mask_cols, frac, _gain, it, done, fracs,
-     ncands, nadms) = jax.lax.while_loop(cond, body, init)
+     ncands, nadms, neligs, ndrops) = jax.lax.while_loop(cond, body, init)
     # ``done`` distinguishes a shortcut that fired on the FINAL scheduled
     # pass from plain schedule exhaustion (the two leave identical ``it``)
-    return codes, qual, lengths, mask_cols, it, fracs, ncands, nadms, done
+    return (codes, qual, lengths, mask_cols, it, fracs, ncands, nadms,
+            neligs, ndrops, done)
 
 
 def _pad_candidates(sread, strand, lread, diag, R_need: int):
@@ -1120,7 +1139,7 @@ class DeviceCorrector:
         sread, strand, lread, diag = _pad_candidates(
             sread, strand, lread, diag, R_need)
 
-        call, n_admitted, scalars, slabs, hpl = _fused_pass(
+        call, n_admitted, n_eligible, scalars, slabs, hpl = _fused_pass(
             map_flat, ignore_flat, codes, qual, lengths,
             q_codes, rc_codes, q_qual, q_lengths,
             sread, strand, lread, diag,
@@ -1132,7 +1151,8 @@ class DeviceCorrector:
                   "fused-enqueue %.0f ms (n_cand=%d, chunks=%d)",
                   (_t1 - _t0) * 1e3, (_t2 - _t1) * 1e3,
                   (_time.time() - _t2) * 1e3, n_cand, n_chunks)
-        stats = DevicePassStats(n_candidates=n_cand, n_admitted=n_admitted)
+        stats = DevicePassStats(n_candidates=n_cand, n_admitted=n_admitted,
+                                n_eligible=n_eligible)
         if haplo and not collect_aln:
             return call, stats, hpl
         if not collect_aln:

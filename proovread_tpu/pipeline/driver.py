@@ -75,6 +75,12 @@ class PipelineConfig:
     # admission budget; > 0 = explicit coverage cutoff (also auto-tightens)
     haplo_coverage: Optional[float] = None
     device_chunk: int = 8192          # candidates per bsw kernel launch
+    # candidates per host-path SW slab (engine="scan" / the ladder's
+    # host-scan rung): slabs always pad to this many rows, so small
+    # workloads can cut dead-row work by lowering it. Chunking never
+    # changes admission (global over all chunks) but float vote-sum order
+    # follows it, so it is part of the checkpoint fingerprint.
+    host_chunk_rows: int = 4096
     seed_stride: int = 8              # device-seeder probe stride
     length_slack: float = 0.2         # Lp headroom for consensus growth
     # max device bytes for the resident short-read set (codes + revcomp +
@@ -84,6 +90,22 @@ class PipelineConfig:
     # when set, the finish pass dumps its admitted alignments as SAM here
     # (bam2cns --debug's filtered-BAM role, bin/bam2cns:271-295)
     debug_dir: Optional[str] = None
+    # -- resilience (pipeline/resilience.py) ------------------------------
+    # per-bucket checkpoint journal directory (CLI default:
+    # <out>/.proovread_ckpt); None disables checkpointing
+    checkpoint_dir: Optional[str] = None
+    # replay completed buckets from the journal (byte-identical output;
+    # the sampler rotation is restored per replayed bucket)
+    resume: bool = False
+    # per-bucket soft wall-clock budget in seconds (SIGALRM, main thread
+    # only); a breach counts as a 'timeout' fault and demotes the bucket
+    bucket_timeout: Optional[float] = None
+    # degradation ladder on device faults: fused -> eager -> chunk-halved
+    # -> host-scan. False = fail fast (pre-resilience behavior)
+    ladder: bool = True
+    # fault-injection spec (testing/faults.py grammar); None reads the
+    # PROOVREAD_FAULT env var
+    fault_spec: Optional[str] = None
 
 
 @dataclass
@@ -92,6 +114,14 @@ class TaskReport:
     masked_frac: float
     n_candidates: int
     n_admitted: int
+    # saturation KPIs (VERDICT r5 weak #5): candidates silently truncated
+    # by the fused loop's static chunk provisioning, and threshold-passed
+    # candidates evicted by the max_coverage bin-budget admission
+    n_dropped_cap: int = 0
+    n_dropped_cov: int = 0
+    # resilience events (demotions, journal replays) carry their reason
+    # here so degraded or replayed output is attributable, never silent
+    note: str = ""
 
 
 @dataclass
@@ -157,11 +187,26 @@ class _SrDevice:
             [sr_all.lengths, np.zeros(1, np.int32)])
         self.pad_idx = len(sr_all.lengths)
         self.resident = resident
+        # streaming-path caches: the full-set device slab (a full-set take
+        # re-uploads identical bytes every pass otherwise) and per-target
+        # pad-row index tails (rebuilt np.full arrays per pass otherwise)
+        self._full_cache = None
+        self._pad_tails: Dict[int, np.ndarray] = {}
         if resident:
             self.codes = jnp.asarray(self._codes_np)
             self.qual = jnp.asarray(self._qual_np)
             self.lengths = jnp.asarray(self._lengths_np)
             self.rc = device_revcomp(self.codes, self.lengths)
+
+    def _pad_tail(self, n_pad: int, dtype) -> np.ndarray:
+        """Cached pad-row index slab (all rows point at the zero-length
+        sentinel): the tail only varies by padded size, so per-pass
+        np.full rebuilds are pure waste at scale."""
+        t = self._pad_tails.get(n_pad)
+        if t is None or t.dtype != dtype:
+            t = np.full(n_pad, self.pad_idx, dtype)
+            self._pad_tails[n_pad] = t
+        return t
 
     def take(self, sel: np.ndarray, pad_multiple: int = 512):
         import jax.numpy as jnp
@@ -175,18 +220,29 @@ class _SrDevice:
                 return self.codes, self.rc, self.qual, self.lengths
             target = max(pad_multiple, -(-n // pad_multiple) * pad_multiple)
             idx = np.concatenate(
-                [sel, np.full(target - n, self.pad_idx)]).astype(np.int32)
+                [sel.astype(np.int32, copy=False),
+                 self._pad_tail(target - n, np.int32)])
             i = jnp.asarray(idx)
             return self.codes[i], self.rc[i], self.qual[i], self.lengths[i]
         # streaming: host slice -> one slab upload; revcomp on device
         if n == self.pad_idx:
-            cn, qn, ln = self._codes_np, self._qual_np, self._lengths_np
-        else:
-            target = max(pad_multiple, -(-n // pad_multiple) * pad_multiple)
-            idx = np.concatenate(
-                [sel, np.full(target - n, self.pad_idx)]).astype(np.int64)
-            cn, qn, ln = (self._codes_np[idx], self._qual_np[idx],
-                          self._lengths_np[idx])
+            # full set: mirror the resident fast path — cache the uploaded
+            # slab + revcomp once and reuse it every pass (the slab IS the
+            # full set here, so residency is unchanged; only the repeated
+            # upload and revcomp recompute are saved)
+            if self._full_cache is None:
+                codes = jnp.asarray(self._codes_np)
+                qual = jnp.asarray(self._qual_np)
+                lengths = jnp.asarray(self._lengths_np)
+                self._full_cache = (codes, device_revcomp(codes, lengths),
+                                    qual, lengths)
+            return self._full_cache
+        target = max(pad_multiple, -(-n // pad_multiple) * pad_multiple)
+        idx = np.concatenate(
+            [sel.astype(np.int64, copy=False),
+             self._pad_tail(target - n, np.int64)])
+        cn, qn, ln = (self._codes_np[idx], self._qual_np[idx],
+                      self._lengths_np[idx])
         codes = jnp.asarray(cn)
         qual = jnp.asarray(qn)
         lengths = jnp.asarray(ln)
@@ -254,6 +310,49 @@ class Pipeline:
             self._sr_ids = [r.id for r in short_records]
             self._sr_lens = np.asarray([len(r) for r in short_records])
 
+        # -- resilience setup (pipeline/resilience.py) --------------------
+        import os as _os
+
+        from proovread_tpu.pipeline.resilience import (CheckpointJournal,
+                                                       bucket_key,
+                                                       run_fingerprint)
+        from proovread_tpu.testing.faults import FaultPlan
+        self._faults = FaultPlan.from_spec(
+            cfg.fault_spec if cfg.fault_spec is not None
+            else _os.environ.get("PROOVREAD_FAULT"))
+        if self._faults.active:
+            log.warning("fault injection active: %d rule(s)",
+                        len(self._faults.rules))
+        journal = None
+        if cfg.checkpoint_dir:
+            journal = CheckpointJournal(
+                cfg.checkpoint_dir,
+                run_fingerprint(cfg, [r.id for r in kept],
+                                len(short_records)),
+                resume=cfg.resume)
+            if cfg.resume:
+                log.info("resume: checkpoint journal at %s holds %d "
+                         "completed bucket(s)", cfg.checkpoint_dir,
+                         len(journal.entries))
+
+        def _replay(key, gi, n_groups):
+            """Journal hit: splice the bucket's stored results + reports
+            back in, restore the sampler rotation, and record the resume
+            event in the report stream (never a silent skip)."""
+            hit = journal.get(key) if journal is not None else None
+            if hit is None:
+                return None
+            res_batch, chim, rep_h, sampler_fc = hit
+            reports.extend(rep_h)
+            sampler.first_chunk = sampler_fc
+            note = (f"bucket {gi} replayed from checkpoint journal "
+                    f"({len(res_batch)} reads; journal hit "
+                    f"{journal.hits}/{n_groups})")
+            reports.append(TaskReport(f"resume-b{gi}", 0.0, 0, 0,
+                                      note=note))
+            log.info("resume: %s", note)
+            return res_batch, chim
+
         if cfg.engine == "device":
             # bucket by length: each bucket compiles/pads at its own Lp —
             # padding every read to the global max wastes quadratically at
@@ -278,9 +377,18 @@ class Pipeline:
                 # real length spreads otherwise produce many shapes within
                 # ~10% of each other (config 3: 5 shapes in 17.9k-20k)
                 Lp = 512 * _bucket_chunks(max(1, -(-want // 512)))
-                res_batch, chim = self._run_batch_device(
-                    batch_recs, sr_dev, len(short_records), sampler,
-                    coverage, min_sr_len, reports, Lp)
+                key = bucket_key(batch_recs)
+                hit = _replay(key, gi, len(groups))
+                if hit is not None:
+                    res_batch, chim = hit
+                else:
+                    n_rep0 = len(reports)
+                    res_batch, chim = self._run_bucket_resilient(
+                        gi, batch_recs, sr_dev, short_records, sampler,
+                        coverage, min_sr_len, reports, Lp)
+                    if journal is not None:
+                        journal.put(key, gi, res_batch, chim,
+                                    reports[n_rep0:], sampler.first_chunk)
                 results_final.extend(res_batch)
                 all_chim.extend(chim)
                 # progress/ETA between task lines (Verbose::ProgressBar
@@ -298,14 +406,29 @@ class Pipeline:
             results_final.sort(key=lambda r: natural_key(r.record.id))
             untrimmed.extend(r.record for r in results_final)
         else:
-            for start in range(0, len(kept), cfg.batch_reads):
+            starts = list(range(0, len(kept), cfg.batch_reads))
+            for bi, start in enumerate(starts):
                 batch_recs = kept[start:start + cfg.batch_reads]
-                res_batch, chim = self._run_batch(
-                    batch_recs, sr_all, short_records, sampler, coverage,
-                    min_sr_len, reports)
+                key = bucket_key(batch_recs)
+                hit = _replay(key, bi, len(starts))
+                if hit is not None:
+                    res_batch, chim = hit
+                else:
+                    n_rep0 = len(reports)
+                    res_batch, chim = self._run_batch(
+                        batch_recs, sr_all, short_records, sampler,
+                        coverage, min_sr_len, reports)
+                    if journal is not None:
+                        journal.put(key, bi, res_batch, chim,
+                                    reports[n_rep0:], sampler.first_chunk)
                 results_final.extend(res_batch)
                 all_chim.extend(chim)
                 untrimmed.extend(r.record for r in res_batch)
+
+        if journal is not None and cfg.resume:
+            log.info("resume: %d journal hit(s); journal now holds %d "
+                     "completed bucket(s)", journal.hits,
+                     len(journal.entries))
 
         trimmed = trim_records(results_final, cfg.trim)
         return PipelineResult(untrimmed, trimmed, ignored, all_chim, reports)
@@ -315,25 +438,139 @@ class Pipeline:
         variants while not padding tiny buckets to the full batch)."""
         return min(self.config.batch_reads, max(32, -(-n // 32) * 32))
 
+    def _get_dc(self, chunk: int):
+        """DeviceCorrector per chunk size (the ladder's chunk-halved rung
+        needs its own corrector; normal runs only ever build one)."""
+        from proovread_tpu.pipeline.dcorrect import DeviceCorrector
+        if not hasattr(self, "_dcs"):
+            self._dcs: Dict[int, object] = {}
+        if chunk not in self._dcs:
+            self._dcs[chunk] = DeviceCorrector(chunk=chunk)
+        return self._dcs[chunk]
+
+    def _level_chunk(self, level) -> int:
+        """Effective device chunk at a ladder rung. The top rungs use the
+        raw config value (so a misconfigured chunk still trips the
+        DeviceCorrector 128-multiple assert, as before the ladder);
+        demoted rungs round the divided chunk to the kernel's 128-row
+        block floor."""
+        cfg = self.config
+        if level.chunk_div == 1:
+            return cfg.device_chunk
+        return max(128, (cfg.device_chunk // level.chunk_div // 128) * 128)
+
+    def _run_bucket_resilient(self, gi, batch_recs, sr_dev, short_records,
+                              sampler, coverage, min_sr_len, reports, Lp):
+        """One length bucket under the fault boundary: on a device fault
+        (compile / OOM / kernel / timeout — resilience.classify_fault),
+        retry the bucket at the next-cheaper ladder rung, recording the
+        demotion in the report stream. Non-device exceptions propagate.
+        Each attempt restarts the bucket from its original records with
+        the sampler rotation rewound, so a retried bucket sees exactly the
+        short-read subsets a fresh run at that rung would."""
+        from proovread_tpu.ops import pileup_kernel
+        from proovread_tpu.pipeline.resilience import (LADDER,
+                                                       classify_fault,
+                                                       soft_deadline)
+
+        cfg = self.config
+        levels = list(LADDER) if cfg.ladder else [LADDER[0]]
+        if cfg.ladder:
+            # drop rungs that would re-run an identical regime — a
+            # deterministic fault would just recur there, and with a
+            # bucket timeout armed each dead rung burns a full budget:
+            # (1) when the fused program cannot run at all (streaming
+            # residency, per-iteration align schedule, flex mode), the
+            # top rung already executes the eager per-pass loop, so start
+            # the walk at 'eager' instead of a misleadingly-named 'fused';
+            ap_rest = _align_params_cfg(cfg, 2)
+            uniform_rest = all(
+                _align_params_cfg(cfg, i) == ap_rest
+                for i in range(2, cfg.n_iterations + 1))
+            if (cfg.haplo_coverage is not None or not sr_dev.resident
+                    or not uniform_rest):
+                levels = [lv for lv in levels if lv.name != "fused"]
+            # (2) at device_chunk == 128 the halved chunk clamps back to
+            # the kernel's block floor, so 'chunk-halved' would retry the
+            # exact program that just failed (and its unchanged shapes
+            # could not retrace the windowed-pileup toggle either)
+            levels = [lv for lv in levels
+                      if (lv.host or lv.chunk_div == 1
+                          or self._level_chunk(lv) != cfg.device_chunk)]
+        for li, level in enumerate(levels):
+            n_rep0 = len(reports)
+            sampler_fc0 = sampler.first_chunk
+            try:
+                with soft_deadline(cfg.bucket_timeout,
+                                   what=f"bucket {gi}"):
+                    if level.host:
+                        return self._run_batch(
+                            batch_recs, self._scan_sr_all(short_records),
+                            short_records, sampler, coverage, min_sr_len,
+                            reports)
+                    pileup_kernel.force_windowed(level.windowed)
+                    try:
+                        return self._run_batch_device(
+                            batch_recs, sr_dev, len(short_records),
+                            sampler, coverage, min_sr_len, reports, Lp,
+                            gi=gi, level=level)
+                    finally:
+                        pileup_kernel.force_windowed(False)
+            except Exception as e:                      # noqa: BLE001
+                kind = classify_fault(e)
+                if kind is None or not cfg.ladder or li == len(levels) - 1:
+                    raise
+                # drop the failed attempt's partial pass reports and rewind
+                # the sampler so the retry reproduces a fresh bucket run
+                del reports[n_rep0:]
+                sampler.first_chunk = sampler_fc0
+                nxt = levels[li + 1]
+                head = (str(e).splitlines() or [""])[0][:160]
+                note = (f"{kind} fault at rung '{level.name}': demoted "
+                        f"bucket {gi} to '{nxt.name}' — {head}")
+                reports.append(TaskReport(f"demote-b{gi}", 0.0, 0, 0,
+                                          note=note))
+                log.warning(
+                    "bucket %d: %s fault at rung %r — retrying at %r (%s)",
+                    gi, kind, level.name, nxt.name, head)
+        raise AssertionError("unreachable: ladder exhausted without raise")
+
+    def _scan_sr_all(self, short_records):
+        """Short-read batch packed for the host-scan rung: the scan path's
+        SW windows round to 128-lane multiples, unlike the device path's
+        16-row packing. Built once, on first demotion to host-scan."""
+        if not hasattr(self, "_sr_all_scan"):
+            self._sr_all_scan = pack_reads(short_records, pad_multiple=128)
+        return self._sr_all_scan
+
     def _run_batch_device(self, batch_recs, sr_dev, n_short, sampler,
-                          coverage, min_sr_len, reports, Lp):
+                          coverage, min_sr_len, reports, Lp,
+                          gi: int = 0, level=None):
         """Device-resident iteration loop: per pass, only the masked-% KPI
         and the candidate count touch the host; corrected reads come back
-        once, after the finish pass (pipeline/dcorrect.py)."""
+        once, after the finish pass (pipeline/dcorrect.py).
+
+        ``gi``: bucket ordinal (fault-injection addressing + demotion
+        notes). ``level``: the resilience-ladder rung this attempt runs at
+        (None = the top 'fused' rung): ``level.fused`` gates the fused
+        multi-pass program, ``level.chunk_div`` divides ``device_chunk``."""
         import jax
         import jax.numpy as jnp
         from proovread_tpu.pipeline.dcorrect import (
-            DeviceCorrector, detect_chimera_device, device_assemble,
-            device_hcr_mask)
+            detect_chimera_device, device_assemble, device_hcr_mask)
+        from proovread_tpu.pipeline.resilience import LADDER
 
         cfg = self.config
+        if level is None:
+            level = LADDER[0]
+        faults = getattr(self, "_faults", None)
+        if faults is not None and faults.active:
+            faults.check(gi)                    # bucket-entry site
         B0 = len(batch_recs)
         pad_recs = [SeqRecord(f"_pad{i}", "A" * 8)
                     for i in range(self._batch_rows(B0) - B0)]
         lr = pack_reads(list(batch_recs) + pad_recs, pad_len=Lp)
-        if not hasattr(self, "_dc"):
-            self._dc = DeviceCorrector(chunk=cfg.device_chunk)
-        dc = self._dc
+        dc = self._get_dc(self._level_chunk(level))
         codes = jnp.asarray(lr.codes)
         qual = jnp.asarray(lr.qual)
         lengths = jnp.asarray(lr.lengths)
@@ -359,6 +596,31 @@ class Pipeline:
             return (cfg.hcr_mask if it < 4
                     else cfg.hcr_mask_late).scaled(min_sr_len)
 
+        def _inj(pass_=None):
+            # fault-injection site (testing/faults.py): device passes only
+            if faults is not None and faults.active:
+                faults.check(gi, pass_)
+
+        def _drop_sfx(cap: int, cov: int) -> str:
+            # saturation-KPI task-line suffix: silent caps must be visible
+            return (f" [dropped: {cap} cap, {cov} cov]"
+                    if (cap or cov) else "")
+
+        def _pass_report(task, frac, stats, prev_frac, style=""):
+            """One device_get for an eager pass's KPIs (masked frac +
+            admitted + eligible), TaskReport append, task log line.
+            Returns (new masked_frac, gain vs prev_frac)."""
+            new_frac, n_adm, n_el = jax.device_get(
+                (frac, stats.n_admitted, stats.n_eligible))
+            new_frac = float(new_frac)
+            d_cov = max(0, int(n_el) - int(n_adm))
+            reports.append(TaskReport(task, new_frac,
+                                      int(stats.n_candidates), int(n_adm),
+                                      n_dropped_cov=d_cov))
+            log.info("%s: masked %.1f%%%s%s", task, new_frac * 100, style,
+                     _drop_sfx(0, d_cov))
+            return new_frac, new_frac - prev_frac
+
         cns = _iter_cns()
         flex_budget = None
         if cfg.haplo_coverage is not None:
@@ -376,6 +638,7 @@ class Pipeline:
             fixed = flex_budget                      # explicit cutoff row
             it = 1
             while it <= cfg.n_iterations:
+                _inj(it)
                 ap_i = _align_params_cfg(cfg, it)
                 sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
                     if cfg.sampling else np.arange(n_short)
@@ -404,15 +667,9 @@ class Pipeline:
                 codes, qual, lengths = device_assemble(call, lengths, Lp)
                 mask_cols, frac = device_hcr_mask(
                     qual, lengths, _mask_p(it))
-                new_frac, n_adm = jax.device_get(
-                    (frac, stats.n_admitted))
-                gain = float(new_frac) - masked_frac
-                masked_frac = float(new_frac)
-                task = f"bwa-{cfg.mode[:2]}-{it}"
-                reports.append(TaskReport(task, masked_frac,
-                                          stats.n_candidates, int(n_adm)))
-                log.info("%s: masked %.1f%% (flex)", task,
-                         masked_frac * 100)
+                masked_frac, gain = _pass_report(
+                    f"bwa-{cfg.mode[:2]}-{it}", frac, stats, masked_frac,
+                    " (flex)")
                 it += 1
                 if (masked_frac > cfg.mask_shortcut_frac
                         or gain < cfg.mask_min_gain_frac):
@@ -440,6 +697,7 @@ class Pipeline:
             # buckets) and the oversized program crashed the tunneled
             # compile helper (BENCH_r04, r5 retry log). mr mode needs the
             # eager pass anyway for its distinct BWA_MR_1 params.
+            _inj(1)
             sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
                 if cfg.sampling else np.arange(n_short)
             qc, rcq, qq, qlen = sr_dev.take(sel)
@@ -448,15 +706,9 @@ class Pipeline:
                 seed_stride=cfg.seed_stride)
             codes, qual, lengths = device_assemble(call, lengths, Lp)
             mask_cols, frac = device_hcr_mask(qual, lengths, _mask_p(1))
-            new_frac, n_adm, n_c = jax.device_get(
-                (frac, stats.n_admitted, stats.n_candidates))
             n_cand_seen = int(stats.n_candidates)
-            gain = float(new_frac) - masked_frac
-            masked_frac = float(new_frac)
-            task1 = f"bwa-{cfg.mode[:2]}-1"
-            reports.append(TaskReport(task1, masked_frac, int(n_c),
-                                      int(n_adm)))
-            log.info("%s: masked %.1f%%", task1, masked_frac * 100)
+            masked_frac, gain = _pass_report(
+                f"bwa-{cfg.mode[:2]}-1", frac, stats, masked_frac)
             if (masked_frac > cfg.mask_shortcut_frac
                     or gain < cfg.mask_min_gain_frac):
                 log.info("mask shortcut: skipping to finish "
@@ -464,12 +716,16 @@ class Pipeline:
                 first_fused = cfg.n_iterations + 1   # no fused passes
 
         if (cfg.haplo_coverage is None
-                and (not sr_dev.resident or not uniform_rest)
+                and (not sr_dev.resident or not uniform_rest
+                     or not level.fused)
                 and first_fused <= cfg.n_iterations):
             # eager pass loop, for the regimes the fused program can't
             # express: streaming (whole-SR residency forbidden by the
-            # budget) and per-iteration align params (legacy schedule)
+            # budget), per-iteration align params (legacy schedule), and
+            # the resilience ladder's demoted rungs (a compile failure of
+            # the big fused program must not recur on retry)
             for it in range(first_fused, cfg.n_iterations + 1):
+                _inj(it)
                 sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
                     if cfg.sampling else np.arange(n_short)
                 qc, rcq, qq, qlen = sr_dev.take(sel)
@@ -480,15 +736,9 @@ class Pipeline:
                 codes, qual, lengths = device_assemble(call, lengths, Lp)
                 mask_cols, frac = device_hcr_mask(qual, lengths,
                                                   _mask_p(it))
-                new_frac, n_adm = jax.device_get(
-                    (frac, stats.n_admitted))
-                gain = float(new_frac) - masked_frac
-                masked_frac = float(new_frac)
-                task = f"bwa-{cfg.mode[:2]}-{it}"
-                reports.append(TaskReport(task, masked_frac,
-                                          stats.n_candidates, int(n_adm)))
-                log.info("%s: masked %.1f%% (eager)", task,
-                         masked_frac * 100)
+                masked_frac, gain = _pass_report(
+                    f"bwa-{cfg.mode[:2]}-{it}", frac, stats, masked_frac,
+                    " (eager)")
                 if (masked_frac > cfg.mask_shortcut_frac
                         or gain < cfg.mask_min_gain_frac):
                     log.info("mask shortcut: skipping to finish "
@@ -500,6 +750,11 @@ class Pipeline:
         if n_fused > 0:
             # -- the whole remaining schedule: ONE device program, the
             # shortcut decision on device, ONE result fetch --------------
+            # the fused program covers its whole pass span in one compile +
+            # launch, so an injected fault addressed to any covered pass
+            # takes the whole span down (as a real compile failure would)
+            if faults is not None and faults.active:
+                faults.check_span(gi, first_fused, cfg.n_iterations)
             sels_l = []
             for _ in range(n_fused):
                 sels_l.append(
@@ -524,10 +779,10 @@ class Pipeline:
             # per-batch maximum — masking only removes index k-mers) with
             # 1.5x slack, capped by the ~2-per-sampled-read structural
             # bound; chunks past the live count skip at runtime (lax.cond)
-            cap = max(1, -(-2 * Rsel // cfg.device_chunk))
+            cap = max(1, -(-2 * Rsel // dc.chunk))
             if n_cand_seen is not None:
                 need = max(1, -(-int(n_cand_seen * 1.5)
-                                // cfg.device_chunk))
+                                // dc.chunk))
                 cap = min(cap, need)
             static_chunks = _bucket_chunks(cap)
             out = fused_iterations(
@@ -535,27 +790,34 @@ class Pipeline:
                 sr_dev.codes, sr_dev.rc, sr_dev.qual, sr_dev.lengths,
                 jnp.asarray(sels), jnp.asarray(pvs),
                 m=sr_dev.codes.shape[1], W=_bsw.band_lanes(ap_rest),
-                CH=cfg.device_chunk, n_chunks=static_chunks, ap=ap_rest,
+                CH=dc.chunk, n_chunks=static_chunks, ap=ap_rest,
                 cns=cns, interpret=dc.interpret, n_rest=n_fused, Lp=Lp,
                 seed_stride=cfg.seed_stride, seed_min_votes=2,
                 shortcut_frac=cfg.mask_shortcut_frac,
                 min_gain=cfg.mask_min_gain_frac, full_set=full_set)
             codes, qual, lengths, mask_cols = out[:4]
             # ONE RPC for the whole schedule's KPIs
-            n_done, fracs, ncands, nadms, sc_done = jax.device_get(out[4:])
+            n_done, fracs, ncands, nadms, neligs, ndrops, sc_done = \
+                jax.device_get(out[4:])
             for k in range(int(n_done)):
                 masked_frac = float(fracs[k])
+                d_cap = int(ndrops[k])
+                d_cov = max(0, int(neligs[k]) - int(nadms[k]))
                 reports.append(TaskReport(
                     f"bwa-{cfg.mode[:2]}-{first_fused + k}", masked_frac,
-                    int(ncands[k]), int(nadms[k])))
-                log.info("bwa-%s-%d: masked %.1f%%", cfg.mode[:2],
-                         first_fused + k, masked_frac * 100)
+                    int(ncands[k]), int(nadms[k]),
+                    n_dropped_cap=d_cap, n_dropped_cov=d_cov))
+                log.info("bwa-%s-%d: masked %.1f%%%s", cfg.mode[:2],
+                         first_fused + k, masked_frac * 100,
+                         _drop_sfx(d_cap, d_cov))
             if bool(sc_done):
                 log.info("mask shortcut: skipped to finish on device "
                          "(masked %.3f)", masked_frac)
 
         # finish: strict params, UNMASKED ref, no ref-qual recycling,
-        # chimera detection (bin/proovread:1573-1579)
+        # chimera detection (bin/proovread:1573-1579). The finish pass is
+        # addressable by the injection harness as pass n_iterations + 1.
+        _inj(cfg.n_iterations + 1)
         ap = _align_params_cfg(cfg, None)
         cns = ConsensusParams(
             qual_weighted=False, use_ref_qual=False,
@@ -627,11 +889,16 @@ class Pipeline:
                      nrec, path)
         frac_phred0 = float(np.mean([o.masked_frac for o in out])) if out \
             else 0.0
+        fin_adm, fin_el = jax.device_get((stats.n_admitted,
+                                          stats.n_eligible))
+        fin_adm = int(fin_adm)
+        fin_cov = max(0, int(fin_el) - fin_adm)
         reports.append(TaskReport(f"bwa-{cfg.mode[:2]}-finish",
                                   1.0 - frac_phred0,
-                                  stats.n_candidates,
-                                  int(np.asarray(stats.n_admitted))))
-        log.info("finish: supported %.1f%%", (1.0 - frac_phred0) * 100)
+                                  stats.n_candidates, fin_adm,
+                                  n_dropped_cov=fin_cov))
+        log.info("finish: supported %.1f%%%s", (1.0 - frac_phred0) * 100,
+                 _drop_sfx(0, fin_cov))
         chim = [(o.record.id, f, t, s) for o in out for (f, t, s) in o.chimera]
         return out, chim
 
@@ -664,7 +931,8 @@ class Pipeline:
                 indel_taboo_length=cfg.indel_taboo_length,
                 max_coverage=max_cov, trim=cfg.sr_trim,
             )
-            fc = FastCorrector(align_params=ap, cns_params=cns)
+            fc = FastCorrector(align_params=ap, cns_params=cns,
+                               chunk_rows=cfg.host_chunk_rows)
 
             sel = sampler.select(len(short_records), coverage,
                                  cfg.sr_coverage) if cfg.sampling else \
@@ -692,7 +960,8 @@ class Pipeline:
             gain = new_frac - masked_frac
             masked_frac = new_frac
             reports.append(TaskReport(task, masked_frac, stats.n_candidates,
-                                      stats.n_admitted))
+                                      stats.n_admitted,
+                                      n_dropped_cov=stats.n_dropped_cov))
             log.info("%s: masked %.1f%%", task, masked_frac * 100)
 
             it += 1
@@ -713,7 +982,8 @@ class Pipeline:
                                  * cfg.coverage_scale + 0.5), 1),
             trim=cfg.sr_trim,
         )
-        fc = FastCorrector(align_params=ap, cns_params=cns)
+        fc = FastCorrector(align_params=ap, cns_params=cns,
+                           chunk_rows=cfg.host_chunk_rows)
         sel = sampler.select(len(short_records), coverage,
                              cfg.finish_coverage) if cfg.sampling else \
             np.arange(len(short_records))
@@ -725,7 +995,8 @@ class Pipeline:
         frac_phred0 = float(np.mean([o.masked_frac for o in out])) if out else 0.0
         reports.append(TaskReport(f"bwa-{cfg.mode[:2]}-finish",
                                   1.0 - frac_phred0,
-                                  stats.n_candidates, stats.n_admitted))
+                                  stats.n_candidates, stats.n_admitted,
+                                  n_dropped_cov=stats.n_dropped_cov))
         log.info("finish: supported %.1f%%", (1.0 - frac_phred0) * 100)
 
         chim = [(o.record.id, f, t, s) for o in out for (f, t, s) in o.chimera]

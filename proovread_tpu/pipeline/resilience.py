@@ -1,0 +1,386 @@
+"""Fault-isolated pipeline execution: the per-bucket degradation ladder and
+the checkpoint/resume journal.
+
+The reference gets fault tolerance for free: correction is thousands of
+independent chunk jobs under ``xargs -P`` (``README.org:59-78``), any of
+which can be rerun without touching the rest. Our device pipeline is one
+process whose per-bucket iteration loops share a runtime — so one XLA
+compile-helper death, VMEM overflow or oversized fused program used to kill
+the entire run and discard hours of completed buckets (VERDICT r5). This
+module restores the reference's two properties at the length-bucket
+granularity:
+
+**Degradation ladder** (:data:`LADDER`): a bucket that raises a *device*
+fault — compile failure, RESOURCE_EXHAUSTED, Pallas/Mosaic kernel fault, or
+a wall-clock timeout (:func:`soft_deadline`) — is retried at the
+next-cheaper regime instead of aborting the run:
+
+    fused      the normal schedule (passes 2..N as one device program)
+    eager      per-pass device loop (no fused program: a compile failure
+               of the big fused program cannot recur; each pass is a small,
+               already-proven compile)
+    chunk-halved
+               eager loop with ``device_chunk`` halved and the windowed-DMA
+               pileup variant forced (``ops/pileup_kernel.force_windowed``)
+               — halves the largest per-launch allocations, the usual
+               RESOURCE_EXHAUSTED culprits
+    host-scan  the host-admission ``engine="scan"`` path
+               (``pipeline/correct.py``) — no XLA program over device
+               state at all; always completes
+
+Every demotion is recorded in the ``TaskReport`` stream (``task`` =
+``demote-b<i>``, reason in ``note``) and logged, so degraded output is
+attributable, never silent. Non-device exceptions (a ``ValueError`` from a
+shape bug, a ``KeyboardInterrupt``) are NOT absorbed — they propagate,
+because retrying would mask a real defect.
+
+**Checkpoint/resume journal** (:class:`CheckpointJournal`): after each
+bucket completes, its corrected records + per-bucket reports + the
+coverage-sampler rotation are appended to ``<out>/.proovread_ckpt/`` (one
+atomic JSON file per bucket, keyed by a hash of the bucket's read ids, all
+under a config/input fingerprint). A crashed or killed run restarted with
+``--resume`` replays completed buckets from the journal — the sampler
+rotation restores, so later buckets draw the same short-read subsets and
+the final output is byte-identical to an uninterrupted run (the natural-key
+re-sort after the bucket loop makes ordering insensitive to which buckets
+were replayed).
+
+Fault injection for tests lives in ``proovread_tpu/testing/faults.py``
+(``PROOVREAD_FAULT`` env hook); see ``docs/RESILIENCE.md``.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.testing.faults import (BucketTimeout, InjectedFault,
+                                          WallClockExceeded)
+
+log = logging.getLogger("proovread_tpu")
+
+
+# --------------------------------------------------------------------------
+# fault classification
+# --------------------------------------------------------------------------
+
+# message substrings of the device-fault classes observed on the tunneled
+# runtime (bench.py._retry's transient list + the r4/r5 crash logs), keyed
+# by the ladder's fault taxonomy
+_OOM_MARKS = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+              "Attempting to allocate", "vmem", "VMEM")
+_COMPILE_MARKS = ("remote_compile", "XLA compilation", "Compilation failure",
+                  "compile", "INTERNAL")
+_KERNEL_MARKS = ("Mosaic", "Pallas", "mosaic")
+_TIMEOUT_MARKS = ("DEADLINE_EXCEEDED",)
+
+
+def classify_fault(exc: BaseException) -> Optional[str]:
+    """Map an exception to a ladder fault kind (``compile`` / ``oom`` /
+    ``kernel`` / ``timeout``), or ``None`` for exceptions the ladder must
+    NOT absorb (logic errors, keyboard interrupts, ...).
+
+    Only runtime-class exceptions are eligible: ``jax.errors.JaxRuntimeError``
+    and plain ``RuntimeError`` (XlaRuntimeError's base), plus the injected
+    fault types. A ``ValueError`` from a real shape bug never matches."""
+    if isinstance(exc, WallClockExceeded):
+        return None     # run-level budget breach: abort the run, not demote
+    if isinstance(exc, BucketTimeout):
+        return "timeout"
+    if isinstance(exc, InjectedFault):
+        msg = str(exc)
+        for marks, kind in ((_OOM_MARKS, "oom"), (_KERNEL_MARKS, "kernel"),
+                            (_COMPILE_MARKS, "compile")):
+            if any(s in msg for s in marks):
+                return kind
+        return "compile"
+    if not isinstance(exc, RuntimeError):
+        return None
+    msg = str(exc)
+    for marks, kind in ((_TIMEOUT_MARKS, "timeout"), (_OOM_MARKS, "oom"),
+                        (_KERNEL_MARKS, "kernel"),
+                        (_COMPILE_MARKS, "compile")):
+        if any(s in msg for s in marks):
+            return kind
+    return None
+
+
+# --------------------------------------------------------------------------
+# per-bucket wall-clock budget
+# --------------------------------------------------------------------------
+
+@contextmanager
+def soft_deadline(seconds: Optional[float], what: str = "bucket",
+                  exc: type = BucketTimeout):
+    """Best-effort wall-clock budget around a blocking region: SIGALRM
+    raises ``exc`` (default :class:`BucketTimeout`) after ``seconds``.
+    No-op when ``seconds`` is falsy or off the main thread (signals only
+    deliver there).
+
+    Run-level budgets (``bench.py --wall-budget``) must pass
+    ``exc=WallClockExceeded`` so the degradation ladder does not mistake
+    the run deadline for a per-bucket one and demote instead of aborting.
+
+    Best-effort because a signal interrupts Python bytecode, not a blocked
+    C call — a wedged device RPC raises only when control returns to
+    Python. Nesting composes: the inner region arms the timer at
+    ``min(inner budget, outer remaining)`` — if the OUTER deadline falls
+    due inside the inner region, the outer handler fires there and then
+    (it is not suspended until the bucket exits) — and the outer timer is
+    re-armed with elapsed time subtracted on exit."""
+    if (not seconds or seconds <= 0
+            or threading.current_thread() is not threading.main_thread()):
+        yield
+        return
+
+    # cancel the (possible) outer timer first so we learn its remaining
+    # time; it is re-armed below and in the finally block
+    prev_delay, _ = signal.setitimer(signal.ITIMER_REAL, 0)
+    start = time.monotonic()
+
+    def _handler(signum, frame):
+        if time.monotonic() - start >= seconds - 0.01:
+            raise exc(f"{what}: soft wall-clock deadline of "
+                      f"{seconds:.0f}s exceeded")
+        # the OUTER deadline came due first: defer to its handler
+        if callable(prev_handler):
+            prev_handler(signum, frame)
+        raise exc(f"{what}: enclosing wall-clock deadline exceeded")
+
+    prev_handler = signal.signal(signal.SIGALRM, _handler)
+    signal.setitimer(signal.ITIMER_REAL,
+                     min(seconds, prev_delay) if prev_delay else seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, prev_handler)
+        if prev_delay:
+            remaining = max(0.001,
+                            prev_delay - (time.monotonic() - start))
+            signal.setitimer(signal.ITIMER_REAL, remaining)
+
+
+# --------------------------------------------------------------------------
+# degradation ladder
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class LadderLevel:
+    name: str
+    fused: bool = False        # fused multi-pass program allowed
+    chunk_div: int = 1         # device_chunk divisor
+    windowed: bool = False     # force the windowed-DMA pileup kernel
+    host: bool = False         # host engine="scan" path
+
+
+LADDER: Tuple[LadderLevel, ...] = (
+    LadderLevel("fused", fused=True),
+    LadderLevel("eager"),
+    LadderLevel("chunk-halved", chunk_div=2, windowed=True),
+    LadderLevel("host-scan", host=True),
+)
+
+
+# --------------------------------------------------------------------------
+# checkpoint/resume journal
+# --------------------------------------------------------------------------
+
+def run_fingerprint(cfg, long_ids: Sequence[str], n_short: int) -> str:
+    """Identity of a run for journal validity: the inputs (long-read ids +
+    short-read count) and every config knob that changes corrected output.
+    A mismatched fingerprint means the journal answers a different question
+    — it is ignored (with a warning), never silently replayed."""
+    knobs = {
+        "mode": cfg.mode, "n_iterations": cfg.n_iterations,
+        "sr_coverage": cfg.sr_coverage,
+        "finish_coverage": cfg.finish_coverage,
+        "coverage": cfg.coverage,
+        "mask_shortcut_frac": cfg.mask_shortcut_frac,
+        "mask_min_gain_frac": cfg.mask_min_gain_frac,
+        "sampling": cfg.sampling,
+        "sr_chunk_number": cfg.sr_chunk_number,
+        "sr_chunk_step": cfg.sr_chunk_step,
+        "sr_trim": cfg.sr_trim,
+        "engine": cfg.engine,
+        "batch_reads": cfg.batch_reads,
+        "device_chunk": cfg.device_chunk,
+        "host_chunk_rows": cfg.host_chunk_rows,
+        "seed_stride": cfg.seed_stride,
+        "haplo_coverage": cfg.haplo_coverage,
+        "indel_taboo_length": cfg.indel_taboo_length,
+        "coverage_scale": cfg.coverage_scale,
+        # dataclass knobs go in by repr (stable field order): masking and
+        # the mapper schedule both change consensus output directly
+        "hcr_mask": repr(cfg.hcr_mask),
+        "hcr_mask_late": repr(cfg.hcr_mask_late),
+        "align_schedule": repr(sorted(
+            (k, repr(v)) for k, v in (cfg.align_schedule or {}).items())),
+        "n_long": len(long_ids), "n_short": n_short,
+    }
+    h = hashlib.sha256(json.dumps(knobs, sort_keys=True).encode())
+    for rid in long_ids:
+        h.update(rid.encode())
+        h.update(b"\0")
+    return h.hexdigest()[:32]
+
+
+def bucket_key(records: Sequence[SeqRecord]) -> str:
+    """Content key of one bucket: hash of its (ordered) read ids. Stable
+    across runs of the same input; a changed bucket partition (different
+    batch_reads, different inputs) simply misses."""
+    h = hashlib.sha1()
+    for r in records:
+        h.update(r.id.encode())
+        h.update(b"\0")
+    return h.hexdigest()[:16]
+
+
+def _encode_qual(qual: Optional[np.ndarray]) -> Optional[str]:
+    if qual is None:
+        return None
+    return base64.b64encode(np.asarray(qual, np.uint8).tobytes()).decode()
+
+
+def _decode_qual(s: Optional[str]) -> Optional[np.ndarray]:
+    if s is None:
+        return None
+    return np.frombuffer(base64.b64decode(s), np.uint8).copy()
+
+
+class CheckpointJournal:
+    """Append-only per-bucket journal under ``<dir>/``.
+
+    Layout: ``meta.json`` (run fingerprint) + one ``bucket_<key>.json`` per
+    completed bucket, written atomically (tmp + ``os.replace``) so a kill
+    mid-write leaves either the old state or the new state, never a torn
+    file. A torn/unparseable entry is skipped at load, costing only that
+    bucket's recompute.
+
+    What is stored per record is exactly what the post-bucket-loop stages
+    consume: id/seq/qual/desc (the untrimmed output + quality-window trim)
+    and the chimera breakpoints (the trim split). The auxiliary
+    ``ConsensusResult`` fields (freqs/coverage/cigar/emit_counts) are
+    consumed *during* the bucket and are not persisted; replayed buckets
+    carry empty ones."""
+
+    META = "meta.json"
+
+    def __init__(self, path: str, fingerprint: str, resume: bool):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.hits = 0
+        self.entries = {}
+        os.makedirs(path, exist_ok=True)
+        meta_path = os.path.join(path, self.META)
+        stale = False
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as fh:
+                    meta = json.load(fh)
+                stale = meta.get("fingerprint") != fingerprint
+            except (OSError, json.JSONDecodeError):
+                stale = True
+        if stale:
+            if resume:
+                log.warning(
+                    "resume: checkpoint journal at %s was written by a "
+                    "different run (inputs or config changed) — ignoring "
+                    "it and starting fresh", path)
+            self._clear()
+        with open(meta_path + ".tmp", "w") as fh:
+            json.dump({"fingerprint": fingerprint,
+                       "format": 1}, fh)
+        os.replace(meta_path + ".tmp", meta_path)
+        if resume and not stale:
+            self._load()
+
+    def _clear(self) -> None:
+        for name in os.listdir(self.path):
+            if name.startswith("bucket_") and name.endswith(".json"):
+                try:
+                    os.unlink(os.path.join(self.path, name))
+                except OSError:
+                    pass
+
+    def _load(self) -> None:
+        for name in sorted(os.listdir(self.path)):
+            if not (name.startswith("bucket_") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.path, name)) as fh:
+                    e = json.load(fh)
+                self.entries[e["key"]] = e
+            except (OSError, json.JSONDecodeError, KeyError):
+                log.warning("resume: skipping torn journal entry %s", name)
+
+    # -- write ------------------------------------------------------------
+    def put(self, key: str, bucket: int, results: Sequence, chim: Sequence,
+            reports: Sequence, sampler_first_chunk: int) -> None:
+        entry = {
+            "key": key, "bucket": bucket,
+            "sampler_first_chunk": int(sampler_first_chunk),
+            "records": [{
+                "id": r.record.id, "seq": r.record.seq,
+                "desc": r.record.desc,
+                "qual": _encode_qual(r.record.qual),
+                "chimera": [[int(f), int(t), float(s)]
+                            for (f, t, s) in r.chimera],
+            } for r in results],
+            "chim": [[rid, int(f), int(t), float(s)]
+                     for (rid, f, t, s) in chim],
+            "reports": [{
+                "task": rep.task, "masked_frac": rep.masked_frac,
+                "n_candidates": int(rep.n_candidates),
+                "n_admitted": int(rep.n_admitted),
+                "n_dropped_cap": int(rep.n_dropped_cap),
+                "n_dropped_cov": int(rep.n_dropped_cov),
+                "note": rep.note,
+            } for rep in reports],
+        }
+        dst = os.path.join(self.path, f"bucket_{key}.json")
+        with open(dst + ".tmp", "w") as fh:
+            json.dump(entry, fh)
+        os.replace(dst + ".tmp", dst)
+        self.entries[key] = entry
+
+    # -- read -------------------------------------------------------------
+    def get(self, key: str):
+        """Returns (results, chim, reports, sampler_first_chunk) or None.
+        Import of ConsensusResult is deferred: consensus.engine pulls jax."""
+        e = self.entries.get(key)
+        if e is None:
+            return None
+        from proovread_tpu.consensus.engine import ConsensusResult
+        from proovread_tpu.pipeline.driver import TaskReport
+
+        _empty = np.zeros(0, np.float32)
+        results = [ConsensusResult(
+            record=SeqRecord(id=r["id"], seq=r["seq"],
+                             qual=_decode_qual(r["qual"]),
+                             desc=r.get("desc", "")),
+            freqs=_empty, coverage=_empty, cigar="",
+            chimera=[(f, t, s) for (f, t, s) in r["chimera"]],
+        ) for r in e["records"]]
+        chim = [(rid, f, t, s) for (rid, f, t, s) in e["chim"]]
+        reports = [TaskReport(
+            task=rep["task"], masked_frac=rep["masked_frac"],
+            n_candidates=rep["n_candidates"], n_admitted=rep["n_admitted"],
+            n_dropped_cap=rep.get("n_dropped_cap", 0),
+            n_dropped_cov=rep.get("n_dropped_cov", 0),
+            note=rep.get("note", ""),
+        ) for rep in e["reports"]]
+        self.hits += 1
+        return results, chim, reports, e["sampler_first_chunk"]

@@ -93,9 +93,17 @@ def _pipeline_config(cfg: Config, mode: str, tasks: Sequence[str],
         engine=str(cfg.get("engine")),
         batch_reads=int(cfg.get("batch-reads")),
         device_chunk=int(cfg.get("device-chunk")),
+        host_chunk_rows=int(cfg.get("host-chunk-rows") or 4096),
         seed_stride=int(cfg.get("seed-stride")),
         sr_device_budget=int(cfg.get("sr-device-budget")),
         debug_dir=cfg.get("debug-dir"),
+        checkpoint_dir=cfg.get("checkpoint-dir"),
+        resume=bool(int(cfg.get("resume") or 0)),
+        bucket_timeout=(float(cfg.get("bucket-timeout"))
+                        if cfg.get("bucket-timeout") else None),
+        ladder=bool(int(1 if cfg.get("resilience-ladder") is None
+                        else cfg.get("resilience-ladder"))),
+        fault_spec=cfg.get("fault-spec"),
     )
 
 

@@ -1,0 +1,13 @@
+"""Test-support utilities importable from production code paths (the
+fault-injection hooks must live inside the package so the driver can call
+them without importing from ``tests/``)."""
+
+from proovread_tpu.testing.faults import (BucketTimeout, FaultPlan,
+                                          InjectedCompileError,
+                                          InjectedFault, InjectedKernelFault,
+                                          InjectedOOM)
+
+__all__ = [
+    "BucketTimeout", "FaultPlan", "InjectedCompileError", "InjectedFault",
+    "InjectedKernelFault", "InjectedOOM",
+]

@@ -1,0 +1,172 @@
+"""Deterministic fault injection for the resilience ladder.
+
+The reference design is embarrassingly fault-tolerant — thousands of
+independent chunk jobs via ``xargs -P``, any of which can simply be rerun
+(``README.org:59-78``) — so its failure paths are exercised by ``kill -9``
+in the shell. Our device pipeline is one process, and its real failure
+modes (XLA compile-helper death, ``RESOURCE_EXHAUSTED``, Pallas/Mosaic
+kernel faults, wall-clock hangs) only occur on real hardware at scale.
+This module makes them reproducible on CPU: a :class:`FaultPlan` parsed
+from the ``PROOVREAD_FAULT`` env var (or ``PipelineConfig.fault_spec``)
+raises a fault of the requested class at an exact bucket/pass site inside
+``pipeline/driver.py``, so the degradation ladder and the checkpoint/resume
+journal (``pipeline/resilience.py``) are testable in tier-1.
+
+Spec grammar (semicolon- or comma-separated rules)::
+
+    <kind>@b<bucket>[.p<pass>][x<count>]
+    <kind>@*[.p<pass>][x<count>]
+
+    kind    compile | oom | timeout | kernel
+    bucket  0-based length-bucket index ('*' = any bucket)
+    pass    1..n_iterations; n_iterations+1 addresses the finish pass.
+            Omitted = the rule fires at ANY device site of the bucket,
+            including the bucket-entry site.
+    count   max number of firings (default: unlimited — a rule keeps
+            firing on every ladder retry, which is what walks a bucket
+            down to the host-scan rung)
+
+Examples: ``compile@b0.p2`` (compile failure at bucket 0, pass 2, every
+device attempt), ``oom@b1`` (OOM on any device work in bucket 1),
+``timeout@b2.p1x1`` (one single injected timeout).
+
+Faults are only raised from device-path sites, so the host ``engine="scan"``
+rung — and the scan engine itself — always completes, mirroring reality:
+the host path has no XLA compile step or device memory to exhaust.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+log = logging.getLogger("proovread_tpu")
+
+KINDS = ("compile", "oom", "timeout", "kernel")
+
+
+class InjectedFault(RuntimeError):
+    """Base class for injected device faults (classified by
+    ``resilience.classify_fault`` exactly like their real twins)."""
+
+
+class InjectedCompileError(InjectedFault):
+    """Stands in for an XLA compile failure / tunneled compile-helper
+    death ('remote_compile: response body closed', BENCH_r04 retry log)."""
+
+
+class InjectedOOM(InjectedFault):
+    """Stands in for RESOURCE_EXHAUSTED / device HBM or VMEM overflow."""
+
+
+class InjectedKernelFault(InjectedFault):
+    """Stands in for a Pallas/Mosaic kernel lowering or runtime fault."""
+
+
+class BucketTimeout(RuntimeError):
+    """A bucket exceeded its wall-clock budget. Raised by the injected
+    ``timeout`` kind and by ``resilience.soft_deadline``'s SIGALRM handler."""
+
+
+class WallClockExceeded(Exception):
+    """A RUN-level wall budget breach (``bench.py --wall-budget``).
+
+    Deliberately NOT a RuntimeError and NOT a BucketTimeout: the
+    degradation ladder must never absorb it — a run-level deadline firing
+    mid-bucket has to abort the run (so the caller can record its partial
+    result), not demote the bucket and keep going unbounded."""
+
+
+def make_fault(kind: str, where: str) -> Exception:
+    if kind == "compile":
+        return InjectedCompileError(
+            f"XLA compilation failure (injected at {where})")
+    if kind == "oom":
+        return InjectedOOM(f"RESOURCE_EXHAUSTED: injected OOM at {where}")
+    if kind == "kernel":
+        return InjectedKernelFault(
+            f"Mosaic kernel fault (injected at {where})")
+    if kind == "timeout":
+        return BucketTimeout(f"injected bucket timeout at {where}")
+    raise ValueError(f"unknown fault kind {kind!r}")
+
+
+_RULE_RE = re.compile(
+    r"^(?P<kind>[a-z]+)@(?:b(?P<bucket>\d+)|(?P<any>\*))"
+    r"(?:\.p(?P<pass>\d+))?(?:x(?P<count>\d+))?$")
+
+
+@dataclass
+class FaultRule:
+    kind: str
+    bucket: Optional[int]        # None = any bucket
+    pass_: Optional[int]         # None = any site of the bucket
+    count: Optional[int]         # None = unlimited firings
+    fired: int = 0
+
+    def matches(self, bucket: int, pass_: Optional[int]) -> bool:
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.bucket is not None and self.bucket != bucket:
+            return False
+        if self.pass_ is not None and self.pass_ != pass_:
+            return False
+        return True
+
+
+@dataclass
+class FaultPlan:
+    """Parsed injection plan. Firing counts are per-plan instance, so each
+    ``Pipeline.run`` gets a fresh plan and injection stays deterministic."""
+
+    rules: List[FaultRule] = field(default_factory=list)
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str]) -> "FaultPlan":
+        rules: List[FaultRule] = []
+        for part in re.split(r"[;,]", spec or ""):
+            part = part.strip()
+            if not part:
+                continue
+            m = _RULE_RE.match(part)
+            if not m:
+                raise ValueError(
+                    f"bad PROOVREAD_FAULT rule {part!r} "
+                    "(expected kind@bN[.pM][xK] or kind@*[.pM][xK])")
+            kind = m.group("kind")
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in {part!r} "
+                    f"(known: {', '.join(KINDS)})")
+            rules.append(FaultRule(
+                kind=kind,
+                bucket=None if m.group("any") else int(m.group("bucket")),
+                pass_=int(m.group("pass")) if m.group("pass") else None,
+                count=int(m.group("count")) if m.group("count") else None))
+        return cls(rules)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.rules)
+
+    def check(self, bucket: int, pass_: Optional[int] = None) -> None:
+        """Raise the injected fault if a rule matches this site. Called
+        from the driver's device-path sites only."""
+        for r in self.rules:
+            if r.matches(bucket, pass_):
+                r.fired += 1
+                where = (f"bucket {bucket}" if pass_ is None
+                         else f"bucket {bucket} pass {pass_}")
+                log.warning("fault injection: %s at %s (rule fired %d%s)",
+                            r.kind, where, r.fired,
+                            f"/{r.count}" if r.count else "")
+                raise make_fault(r.kind, where)
+
+    def check_span(self, bucket: int, pass_lo: int, pass_hi: int) -> None:
+        """Raise if any pass index in ``[pass_lo, pass_hi]`` matches — the
+        fused program covers its whole pass span in one compile/launch, so
+        a fault addressed to any covered pass takes down the whole span."""
+        for p in range(pass_lo, pass_hi + 1):
+            self.check(bucket, p)

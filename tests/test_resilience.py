@@ -1,0 +1,400 @@
+"""Resilience-layer tests: fault classification, the per-bucket degradation
+ladder, the checkpoint/resume journal, and the fault-injection harness
+(`docs/RESILIENCE.md`). Everything runs on CPU (interpret-mode Pallas for
+the device engine) — `make test-faults` selects this suite."""
+
+import io
+
+import numpy as np
+import pytest
+
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.ops.encode import decode_codes, revcomp_codes
+from proovread_tpu.pipeline import Pipeline, PipelineConfig, TrimParams
+from proovread_tpu.testing.faults import (BucketTimeout, FaultPlan,
+                                          InjectedCompileError, InjectedOOM,
+                                          make_fault)
+
+pytestmark = pytest.mark.faults
+
+
+# --------------------------------------------------------------------------
+# unit: fault plan parsing + classification
+# --------------------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_parse_full_grammar(self):
+        p = FaultPlan.from_spec("compile@b0.p2; oom@b1, timeout@*.p3x2")
+        assert [(r.kind, r.bucket, r.pass_, r.count) for r in p.rules] == [
+            ("compile", 0, 2, None), ("oom", 1, None, None),
+            ("timeout", None, 3, 2)]
+
+    def test_empty_spec_inactive(self):
+        assert not FaultPlan.from_spec(None).active
+        assert not FaultPlan.from_spec("").active
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError, match="bad PROOVREAD_FAULT"):
+            FaultPlan.from_spec("compile@pass2")
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultPlan.from_spec("boom@b1")
+
+    def test_site_matching_and_counts(self):
+        p = FaultPlan.from_spec("oom@b1x2")
+        p.check(0)                      # other bucket: no fire
+        p.check(0, 3)
+        with pytest.raises(InjectedOOM):
+            p.check(1)                  # fires at bucket entry
+        with pytest.raises(InjectedOOM):
+            p.check(1, 2)               # and at any pass site
+        p.check(1, 2)                   # count exhausted: silent
+
+    def test_pass_scoped_rule_skips_bucket_site(self):
+        p = FaultPlan.from_spec("compile@b0.p2")
+        p.check(0)                      # bucket-entry site: pass rule idle
+        p.check(0, 1)
+        with pytest.raises(InjectedCompileError):
+            p.check(0, 2)
+
+    def test_check_span(self):
+        p = FaultPlan.from_spec("compile@b0.p4")
+        p.check_span(0, 2, 3)           # span misses pass 4
+        with pytest.raises(InjectedCompileError):
+            p.check_span(0, 2, 5)
+
+
+class TestClassify:
+    def test_injected_and_real_marks(self):
+        from proovread_tpu.pipeline.resilience import classify_fault
+        assert classify_fault(make_fault("oom", "x")) == "oom"
+        assert classify_fault(make_fault("compile", "x")) == "compile"
+        assert classify_fault(make_fault("kernel", "x")) == "kernel"
+        assert classify_fault(BucketTimeout("x")) == "timeout"
+        assert classify_fault(
+            RuntimeError("RESOURCE_EXHAUSTED: out of HBM")) == "oom"
+        assert classify_fault(
+            RuntimeError("INTERNAL: remote_compile: response body closed")
+        ) == "compile"
+        assert classify_fault(
+            RuntimeError("Mosaic lowering failed")) == "kernel"
+
+    def test_non_device_errors_not_absorbed(self):
+        from proovread_tpu.pipeline.resilience import classify_fault
+        assert classify_fault(ValueError("RESOURCE_EXHAUSTED")) is None
+        assert classify_fault(KeyboardInterrupt()) is None
+        assert classify_fault(RuntimeError("some logic error")) is None
+
+
+class TestSoftDeadline:
+    def test_times_out_python_loop(self):
+        import time
+        from proovread_tpu.pipeline.resilience import soft_deadline
+        with pytest.raises(BucketTimeout, match="deadline"):
+            with soft_deadline(0.05, what="test"):
+                t0 = time.time()
+                while time.time() - t0 < 5:
+                    pass
+
+    def test_no_op_without_budget(self):
+        from proovread_tpu.pipeline.resilience import soft_deadline
+        with soft_deadline(None):
+            pass
+        with soft_deadline(0):
+            pass
+
+    def test_outer_deadline_fires_inside_inner_region(self):
+        """A run-level budget (bench --wall-budget) must fire even while a
+        longer per-bucket deadline is armed — the inner region arms
+        min(inner, outer remaining) and defers to the outer handler, so
+        the outer exception type (not absorbed by the ladder) surfaces."""
+        import time
+        from proovread_tpu.pipeline.resilience import soft_deadline
+        from proovread_tpu.testing.faults import WallClockExceeded
+        with pytest.raises(WallClockExceeded):
+            with soft_deadline(0.05, what="run", exc=WallClockExceeded):
+                with soft_deadline(5.0, what="bucket"):
+                    t0 = time.time()
+                    while time.time() - t0 < 5:
+                        pass
+
+
+# --------------------------------------------------------------------------
+# unit: checkpoint journal
+# --------------------------------------------------------------------------
+
+def _mini_results():
+    from proovread_tpu.consensus.engine import ConsensusResult
+    e = np.zeros(0, np.float32)
+    r1 = ConsensusResult(
+        record=SeqRecord("a", "ACGT", qual=np.array([1, 2, 3, 40], np.uint8)),
+        freqs=e, coverage=e, cigar="", chimera=[(1, 2, 0.5)])
+    r2 = ConsensusResult(
+        record=SeqRecord("b", "GGTT", qual=np.zeros(4, np.uint8)),
+        freqs=e, coverage=e, cigar="")
+    return [r1, r2]
+
+
+class TestJournal:
+    def test_roundtrip(self, tmp_path):
+        from proovread_tpu.pipeline.driver import TaskReport
+        from proovread_tpu.pipeline.resilience import CheckpointJournal
+        j = CheckpointJournal(str(tmp_path / "ckpt"), "fp1", resume=False)
+        reps = [TaskReport("bwa-sr-1", 0.5, 10, 8, n_dropped_cov=2),
+                TaskReport("demote-b0", 0.0, 0, 0, note="oom fault")]
+        j.put("k1", 0, _mini_results(), [("a", 1, 2, 0.5)], reps, 7)
+
+        j2 = CheckpointJournal(str(tmp_path / "ckpt"), "fp1", resume=True)
+        hit = j2.get("k1")
+        assert hit is not None
+        results, chim, reports, fc = hit
+        assert fc == 7
+        assert chim == [("a", 1, 2, 0.5)]
+        assert [r.record.id for r in results] == ["a", "b"]
+        assert results[0].record.seq == "ACGT"
+        np.testing.assert_array_equal(
+            results[0].record.qual, np.array([1, 2, 3, 40], np.uint8))
+        assert results[0].chimera == [(1, 2, 0.5)]
+        assert reports[0].task == "bwa-sr-1"
+        assert reports[0].n_dropped_cov == 2
+        assert reports[1].note == "oom fault"
+        assert j2.hits == 1
+
+    def test_fingerprint_mismatch_clears(self, tmp_path):
+        from proovread_tpu.pipeline.resilience import CheckpointJournal
+        j = CheckpointJournal(str(tmp_path / "c"), "fp1", resume=False)
+        j.put("k1", 0, _mini_results(), [], [], 1)
+        j2 = CheckpointJournal(str(tmp_path / "c"), "OTHER", resume=True)
+        assert j2.get("k1") is None
+        assert not j2.entries
+
+    def test_torn_entry_skipped(self, tmp_path):
+        from proovread_tpu.pipeline.resilience import CheckpointJournal
+        j = CheckpointJournal(str(tmp_path / "c"), "fp1", resume=False)
+        j.put("k1", 0, _mini_results(), [], [], 1)
+        (tmp_path / "c" / "bucket_torn.json").write_text('{"key": "t..')
+        j2 = CheckpointJournal(str(tmp_path / "c"), "fp1", resume=True)
+        assert j2.get("k1") is not None
+        assert j2.get("torn") is None
+
+
+# --------------------------------------------------------------------------
+# end-to-end: ladder + resume (device engine, interpret-mode Pallas)
+# --------------------------------------------------------------------------
+
+def _uniform_dataset(rng, G=600, n_long=10, read_len=300, n_sr=45,
+                     lr_err=0.08):
+    """Uniform-length long reads so the device length-bucketing and the
+    scan engine's sequential batching produce IDENTICAL partitions (the
+    ladder-parity test compares the two engines record for record)."""
+    genome = rng.integers(0, 4, G).astype(np.int8)
+    longs = []
+    for i in range(n_long):
+        a = int(rng.integers(0, G - read_len))
+        src = genome[a:a + read_len]
+        noisy = []
+        for base in src:
+            u = rng.random()
+            if u < lr_err * 0.5:
+                noisy.append(int(rng.integers(0, 4)))
+                noisy.append(int(base))
+            elif u < lr_err * 0.75:
+                continue
+            elif u < lr_err:
+                noisy.append(int((base + 1) % 4))
+            else:
+                noisy.append(int(base))
+        longs.append(SeqRecord(f"r{i}",
+                               decode_codes(np.array(noisy, np.int8))))
+    srs = []
+    for i in range(n_sr):
+        st = int(rng.integers(0, G - 100))
+        seq = genome[st:st + 100].copy()
+        if rng.random() < 0.5:
+            seq = revcomp_codes(seq)
+        srs.append(SeqRecord(f"s{i}", decode_codes(seq),
+                             qual=np.full(100, 30, np.uint8)))
+    return longs, srs
+
+
+def _records_equal(a, b):
+    assert len(a) == len(b), (len(a), len(b))
+    for x, y in zip(a, b):
+        assert x.id == y.id
+        assert x.seq == y.seq
+        if x.qual is None or y.qual is None:
+            assert x.qual is None and y.qual is None
+        else:
+            np.testing.assert_array_equal(x.qual, y.qual)
+
+
+def _fastq_bytes(records):
+    from proovread_tpu.io.fastq import FastqWriter
+    buf = io.BytesIO()
+    w = FastqWriter(buf)
+    for r in records:
+        w.write(r)
+    return buf.getvalue()
+
+
+def _cfg(**kw):
+    base = dict(mode="sr", n_iterations=2, sampling=False, engine="device",
+                device_chunk=128, batch_reads=8, host_chunk_rows=512,
+                trim=TrimParams(min_length=150))
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+@pytest.mark.heavy
+class TestLadderEndToEnd:
+    def test_injected_faults_degrade_to_scan_parity(self):
+        """Acceptance: with a compile failure injected at bucket 0/pass 2
+        and an OOM at bucket 1, the run completes via the degradation
+        ladder, every demotion is reported, and the output is
+        record-identical to an uninjected engine="scan" run (both faulted
+        buckets walk fused -> eager -> chunk-halved -> host-scan)."""
+        rng = np.random.default_rng(41)
+        longs, srs = _uniform_dataset(rng)
+
+        # device_chunk=256 so the chunk-halved rung is a real regime
+        # change (at 128 it would clamp back to the block floor and be
+        # skipped) — this test walks the FULL ladder
+        res_dev = Pipeline(_cfg(
+            device_chunk=256,
+            fault_spec="compile@b0.p2;oom@b1")).run(longs, srs)
+        res_scan = Pipeline(_cfg(engine="scan")).run(longs, srs)
+
+        _records_equal([r for r in res_dev.untrimmed],
+                       [r for r in res_scan.untrimmed])
+        _records_equal([r for r in res_dev.trimmed],
+                       [r for r in res_scan.trimmed])
+
+        # every demotion is in the report stream — 3 rungs walked per
+        # faulted bucket, reasons attributable, nothing silent
+        d0 = [r for r in res_dev.reports if r.task == "demote-b0"]
+        d1 = [r for r in res_dev.reports if r.task == "demote-b1"]
+        assert len(d0) == 3 and len(d1) == 3
+        assert "compile" in d0[0].note and "oom" in d1[0].note
+        assert "host-scan" in d0[-1].note and "host-scan" in d1[-1].note
+        for rep in d0 + d1:
+            assert rep.note, "silent demotion"
+
+    def test_ladder_off_fails_fast(self):
+        rng = np.random.default_rng(42)
+        longs, srs = _uniform_dataset(rng, n_long=8)
+        with pytest.raises(InjectedOOM):
+            Pipeline(_cfg(ladder=False, fault_spec="oom@b0")).run(longs, srs)
+
+    def test_non_device_fault_not_absorbed(self):
+        """A logic error must propagate, not demote: retrying would mask a
+        real defect."""
+        rng = np.random.default_rng(43)
+        longs, srs = _uniform_dataset(rng, n_long=8)
+        pipe = Pipeline(_cfg())
+
+        def boom(*a, **k):
+            raise ValueError("a real bug")
+        pipe._run_batch_device = boom
+        with pytest.raises(ValueError, match="a real bug"):
+            pipe.run(longs, srs)
+
+
+def _bucketed_dataset(rng, n_sr=36):
+    """Three length classes -> three device buckets (512/1024/2048 pads)."""
+    G = 2000
+    genome = rng.integers(0, 4, G).astype(np.int8)
+    longs = []
+    k = 0
+    for read_len, cnt in ((260, 3), (600, 3), (1400, 3)):
+        for _ in range(cnt):
+            a = int(rng.integers(0, G - read_len))
+            src = genome[a:a + read_len]
+            longs.append(SeqRecord(f"r{k}", decode_codes(src)))
+            k += 1
+    srs = []
+    for i in range(n_sr):
+        st = int(rng.integers(0, G - 100))
+        seq = genome[st:st + 100].copy()
+        if rng.random() < 0.5:
+            seq = revcomp_codes(seq)
+        srs.append(SeqRecord(f"s{i}", decode_codes(seq),
+                             qual=np.full(100, 30, np.uint8)))
+    return longs, srs
+
+
+@pytest.mark.heavy
+class TestCheckpointResume:
+    def test_kill_after_bucket_and_resume_byte_identical(self, tmp_path):
+        """Acceptance: a run killed after bucket 1 of 3 and restarted with
+        resume replays the completed buckets from the journal (journal hit
+        count verifiable in the reports) and produces byte-identical final
+        FASTQ output to an uninterrupted run."""
+        rng = np.random.default_rng(47)
+        longs, srs = _bucketed_dataset(rng)
+
+        # uninterrupted reference run (its own journal dir)
+        res_ref = Pipeline(_cfg(
+            n_iterations=1,
+            checkpoint_dir=str(tmp_path / "ref_ckpt"))).run(longs, srs)
+        ref_unt = _fastq_bytes(res_ref.untrimmed)
+        ref_trm = _fastq_bytes(res_ref.trimmed)
+
+        # the "killed" run: a fail-fast fault at bucket 2 kills the process
+        # after buckets 0 and 1 were journaled
+        ckpt = str(tmp_path / "ckpt")
+        with pytest.raises(InjectedCompileError):
+            Pipeline(_cfg(n_iterations=1, checkpoint_dir=ckpt,
+                          ladder=False,
+                          fault_spec="compile@b2")).run(longs, srs)
+
+        # restart with --resume: buckets 0-1 replay, bucket 2 computes
+        res = Pipeline(_cfg(n_iterations=1, checkpoint_dir=ckpt,
+                            resume=True)).run(longs, srs)
+        resumed = [r for r in res.reports if r.task.startswith("resume-b")]
+        assert len(resumed) == 2, "expected 2 journal hits"
+        assert all("journal" in r.note for r in resumed)
+
+        assert _fastq_bytes(res.untrimmed) == ref_unt
+        assert _fastq_bytes(res.trimmed) == ref_trm
+
+    @pytest.mark.slow
+    def test_resume_full_journal_recomputes_nothing(self, tmp_path):
+        """Restarting a COMPLETED run with resume serves every bucket from
+        the journal and still reproduces identical output."""
+        rng = np.random.default_rng(48)
+        longs, srs = _bucketed_dataset(rng)
+        ckpt = str(tmp_path / "ckpt")
+        res1 = Pipeline(_cfg(n_iterations=1,
+                             checkpoint_dir=ckpt)).run(longs, srs)
+        res2 = Pipeline(_cfg(n_iterations=1, checkpoint_dir=ckpt,
+                             resume=True)).run(longs, srs)
+        resumed = [r for r in res2.reports if r.task.startswith("resume-b")]
+        assert len(resumed) == 3
+        assert _fastq_bytes(res2.untrimmed) == _fastq_bytes(res1.untrimmed)
+        assert _fastq_bytes(res2.trimmed) == _fastq_bytes(res1.trimmed)
+
+    def test_scan_engine_checkpoints_too(self, tmp_path):
+        rng = np.random.default_rng(49)
+        longs, srs = _uniform_dataset(rng)
+        ckpt = str(tmp_path / "ckpt")
+        res1 = Pipeline(_cfg(engine="scan", n_iterations=1, batch_reads=4,
+                             checkpoint_dir=ckpt)).run(longs, srs)
+        res2 = Pipeline(_cfg(engine="scan", n_iterations=1, batch_reads=4,
+                             checkpoint_dir=ckpt,
+                             resume=True)).run(longs, srs)
+        assert any(r.task.startswith("resume-b") for r in res2.reports)
+        assert _fastq_bytes(res2.untrimmed) == _fastq_bytes(res1.untrimmed)
+
+    def test_timeout_fault_demotes(self):
+        """An injected timeout walks the ladder like any device fault.
+        At device_chunk=128 the chunk-halved rung clamps back to the
+        kernel's block floor and is skipped (it would retry the identical
+        regime), so the walk is fused -> eager -> host-scan."""
+        rng = np.random.default_rng(50)
+        longs, srs = _uniform_dataset(rng, n_long=8)
+        res = Pipeline(_cfg(n_iterations=1,
+                            fault_spec="timeout@b0x3")).run(longs, srs)
+        demos = [r for r in res.reports if r.task == "demote-b0"]
+        assert len(demos) == 2
+        assert all("timeout" in d.note for d in demos)
+        assert "host-scan" in demos[-1].note
+        assert len(res.untrimmed) == 8
