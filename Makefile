@@ -1,6 +1,6 @@
 # Convenience targets; the package itself needs no build step.
 
-.PHONY: smoke test test-all test-faults bench
+.PHONY: smoke test test-all test-faults trace-smoke bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -21,6 +21,14 @@ test-all:
 # timeout faults (tier-1-safe; also part of `make test`)
 test-faults:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults
+
+# observability tier: a full CLI run with --trace/--metrics-out, then
+# schema-validation of both artifacts (root span >=95% covered, bucket
+# spans carry the compile/execute split, KPI counter catalog present) —
+# docs/OBSERVABILITY.md. Uses the F.antasticus sample when present, else
+# a synthetic workload; runs on CPU.
+trace-smoke:
+	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.smoke
 
 bench:
 	python bench.py

@@ -155,11 +155,34 @@ def _bench_config(config: int) -> dict:
     res = None
     for k in range(3):
         _log(f"timed run {k + 1}/3")
-        t0 = time.time()
+        t0 = time.monotonic()
         res = _retry(run_once, f"timed run {k + 1}")
-        times.append(time.time() - t0)
+        times.append(time.monotonic() - t0)
     dt = float(np.median(times))
     bases_per_sec = total_bases / dt
+
+    # per-phase attribution run, OFF the clock: tracing fences device work
+    # at span exits (that is what attributes device time to the span that
+    # launched it), which perturbs async dispatch — so the timed runs stay
+    # untraced and a 4th traced run supplies the breakdown
+    phases = n_compiles = compile_s = None
+    try:
+        from proovread_tpu import obs
+        _log("traced attribution run (per-phase breakdown)")
+        with obs.tracing() as tr:
+            _retry(run_once, "attribution run")
+        phases = tr.phase_totals()
+        n_compiles = tr.n_compiles
+        compile_s = round(tr.compile_s, 3)
+    except Exception as e:                                  # noqa: BLE001
+        # the run-level --wall-budget deadline must keep propagating to
+        # main()'s partial-row handler — only attribution-local failures
+        # are downgraded to a missing "phases" entry
+        from proovread_tpu.testing.faults import WallClockExceeded
+        if isinstance(e, WallClockExceeded):
+            raise
+        _log(f"attribution run failed ({type(e).__name__}): "
+             f"{(str(e).splitlines() or [''])[0][:160]}")
     _log(f"median wall {dt:.2f}s -> {bases_per_sec:.0f} b/s; scoring")
 
     corrected = {r.id: r for r in res.untrimmed}
@@ -192,6 +215,12 @@ def _bench_config(config: int) -> dict:
         if len(res.reports) > 1 else None,
         "identity_before": round(id_before, 4),
         "identity_after": round(id_after, 4),
+        # per-phase breakdown from the traced attribution run (span
+        # category -> {count, total_s, compile_s}); see
+        # docs/OBSERVABILITY.md for the category meanings
+        "phases": phases,
+        "n_compiles": n_compiles,
+        "compile_s": compile_s,
     }
 
 
@@ -235,10 +264,10 @@ def main():
         return {"metric": "corrected_bases_per_sec_per_chip",
                 "value": None, "unit": "bases/sec/chip",
                 "config": config, "timeout": True,
-                "wall_s": round(time.time() - t_start, 2),
+                "wall_s": round(time.monotonic() - t_start, 2),
                 "timeout_error": (str(err).splitlines() or [""])[0][:300]}
 
-    t_start = time.time()
+    t_start = time.monotonic()
     try:
         # WallClockExceeded (not BucketTimeout): the pipeline's degradation
         # ladder must not absorb the RUN-level budget as a bucket fault
@@ -259,7 +288,7 @@ def main():
         traceback.print_exc(file=sys.stderr)
         _log(f"config {args.config} failed ({type(e).__name__}); "
              "falling back to config 1")
-        remaining = (args.wall_budget - (time.time() - t_start)
+        remaining = (args.wall_budget - (time.monotonic() - t_start)
                      if args.wall_budget else 0)
         try:
             with soft_deadline(max(remaining, 60) if args.wall_budget

@@ -9,6 +9,12 @@ Outputs (reference layout, ``bin/proovread:904-956``):
 ``<pre>/<name>.untrimmed.fq``, ``.trimmed.fq``, ``.trimmed.fa``,
 ``.ignored.tsv``, ``.chim.tsv``, plus ``.parameter.log`` (``:401-416``) and
 per-task wall-times on stderr.
+
+Observability (docs/OBSERVABILITY.md): ``--trace FILE`` writes the span
+tree as Chrome trace-event JSONL (loadable in Perfetto) and logs an
+end-of-run summary table; ``--metrics-out FILE`` dumps the typed KPI
+counters as one JSON object; ``--log-json`` emits one structured JSON log
+record per line for scrapers.
 """
 
 from __future__ import annotations
@@ -22,6 +28,8 @@ import time
 from typing import List, Optional
 
 import numpy as np
+
+from proovread_tpu import obs
 
 log = logging.getLogger("proovread_tpu")
 
@@ -80,6 +88,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--no-ladder", action="store_true",
                     help="fail fast on device faults instead of retrying "
                          "buckets down the degradation ladder")
+    ap.add_argument("--trace", metavar="FILE",
+                    help="write the span tree as Chrome trace-event JSONL "
+                         "(open in ui.perfetto.dev) and log an end-of-run "
+                         "summary table (docs/OBSERVABILITY.md)")
+    ap.add_argument("--metrics-out", metavar="FILE",
+                    help="dump the typed KPI counters/gauges/histograms "
+                         "as one JSON object (docs/OBSERVABILITY.md)")
+    ap.add_argument("--log-json", action="store_true",
+                    help="one structured JSON log record per line "
+                         "(ts/level/logger/msg) instead of the human "
+                         "format")
     ap.add_argument("--overwrite", action="store_true",
                     help="allow writing into a non-empty output dir")
     ap.add_argument("--keep-temporary-files", action="store_true")
@@ -112,12 +131,54 @@ def _have_subreads(records) -> bool:
     return is_subread_set(records)
 
 
+class _JsonLogFormatter(logging.Formatter):
+    """One JSON object per record: the --log-json scraper format."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        d = {"ts": round(record.created, 3), "level": record.levelname,
+             "logger": record.name, "msg": record.getMessage()}
+        if record.exc_info:
+            d["exc"] = self.formatException(record.exc_info)
+        return json.dumps(d)
+
+
+def _setup_logging(args) -> None:
+    """Configure logging WITHOUT clobbering a host application's setup:
+    ``logging.basicConfig`` only runs when the root logger has no
+    handlers yet (the old unconditional call reset any embedding app's
+    logging whenever the CLI was invoked programmatically)."""
+    level = (logging.DEBUG if args.debug
+             else logging.ERROR if args.quiet else logging.INFO)
+    root = logging.getLogger()
+    if args.log_json:
+        # scope the JSON stream to OUR logger (propagation off), so a
+        # host application's root handlers neither double-emit nor get
+        # clobbered; idempotent across repeated main() calls
+        if not any(isinstance(h.formatter, _JsonLogFormatter)
+                   for h in log.handlers):
+            h = logging.StreamHandler()
+            h.setFormatter(_JsonLogFormatter())
+            log.addHandler(h)
+        log.propagate = False
+        log.setLevel(level)
+        return
+    # non-json call: undo a previous --log-json invocation in-process
+    for h in list(log.handlers):
+        if isinstance(h.formatter, _JsonLogFormatter):
+            log.removeHandler(h)
+    log.propagate = True
+    # always (re)scope our logger's level: a prior --log-json/--quiet
+    # call may have pinned it, which would silence this invocation
+    log.setLevel(level)
+    if not root.handlers:
+        logging.basicConfig(
+            level=level,
+            format="[%(asctime)s] %(message)s", datefmt="%H:%M:%S")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=(logging.DEBUG if args.debug
-               else logging.ERROR if args.quiet else logging.INFO),
-        format="[%(asctime)s] %(message)s", datefmt="%H:%M:%S")
+    _setup_logging(args)
 
     from proovread_tpu.config import Config, mode_auto
 
@@ -172,111 +233,177 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg.data["resilience-ladder"] = 0
     name = os.path.basename(outdir.rstrip("/")) or "proovread"
 
-    t_start = time.time()
-    longs = _read_records(args.long_reads)
-    shorts = _read_records(args.short_reads) if args.short_reads else []
-    utgs = _read_records(args.unitigs) if args.unitigs else []
+    # observability (docs/OBSERVABILITY.md): flags override config keys so
+    # a user cfg can turn tracing on for every run of a deployment
+    trace_path = args.trace or cfg.get("trace-file")
+    metrics_path = args.metrics_out or cfg.get("metrics-out")
+    tracer = obs.install_tracer() if trace_path else None
+    registry = obs.metrics.install() if metrics_path else None
 
-    sr_lens = np.array([len(r) for r in shorts]) if shorts else np.zeros(0)
-    min_sr_len = int(np.median(sr_lens)) if len(sr_lens) else 0
+    t_start = time.monotonic()
+    try:
+        rc = _run(args, argv, cfg, outdir, name, ckpt_dir, mode_auto)
+    finally:
+        # write the artifacts even on a crashed run — the partial span
+        # tree (which bucket/pass was live) and the fault counters are
+        # exactly the data a crash diagnosis needs
+        if tracer is not None:
+            obs.uninstall_tracer()
+            try:
+                tracer.write_chrome(trace_path)
+                log.info("trace: %d span(s) -> %s (load in "
+                         "ui.perfetto.dev)", len(tracer.events),
+                         trace_path)
+                for ln in tracer.summary_lines():
+                    log.info("%s", ln)
+            except OSError as e:
+                log.warning("trace write failed: %s", e)
+        if registry is not None:
+            obs.metrics.uninstall()
+            try:
+                d = registry.as_dict()      # one walk: file + log line
+                with open(metrics_path, "w") as fh:
+                    json.dump(d, fh, indent=2, sort_keys=True)
+                    fh.write("\n")
+                log.info("metrics: %d series -> %s",
+                         sum(len(m["series"])
+                             for sec in ("counters", "gauges",
+                                         "histograms")
+                             for m in d[sec].values()),
+                         metrics_path)
+            except OSError as e:
+                log.warning("metrics write failed: %s", e)
+    if rc != 0:
+        return rc
+    log.info("total wall: %.1fs", time.monotonic() - t_start)
+    return 0
 
-    # preflight (bin/proovread:457-464,586-592): catch mis-supplied inputs
-    # before any compile time is spent
-    if len(sr_lens) and sr_lens.max() > 1000 and not args.ignore_sr_length:
-        print(f"error: short reads up to {int(sr_lens.max())}bp — is -s the "
-              "right file? (--ignore-sr-length to proceed)",
-              file=sys.stderr)
-        return 2
-    too_long = [r.id for r in longs if len(r.id) > 256]
-    if too_long:
-        print(f"error: read id longer than 256 chars: {too_long[0]!r}",
-              file=sys.stderr)
-        return 2
-    import jax
-    log.info("preflight: %d device(s), platform %s",
-             jax.device_count(), jax.devices()[0].platform)
 
-    mode = args.mode
-    if mode == "auto":
-        mode = mode_auto(min_sr_len, bool(utgs), _have_subreads(longs),
-                         sam=bool(args.sam), bam=bool(args.bam))
-    tasks = cfg.tasks(mode)
-    log.info("mode %s: tasks %s", mode, " ".join(tasks))
+def _run(args, argv, cfg, outdir: str, name: str, ckpt_dir: Optional[str],
+         mode_auto) -> int:
+    """The traced portion of a CLI invocation: input read → task run →
+    output write, all inside the root ``run`` span."""
+    with obs.span("run", cat="run"):
+        with obs.span("read-inputs", cat="io"):
+            longs = _read_records(args.long_reads)
+            shorts = _read_records(args.short_reads) \
+                if args.short_reads else []
+            utgs = _read_records(args.unitigs) if args.unitigs else []
 
-    # parameter.log (bin/proovread:401-416)
-    with open(os.path.join(outdir, f"{name}.parameter.log"), "w") as fh:
-        fh.write(json.dumps({
-            "argv": sys.argv if argv is None else ["proovread-tpu"] + argv,
-            "mode": mode, "tasks": tasks,
-            "n_long_reads": len(longs), "n_short_reads": len(shorts),
-            "n_unitigs": len(utgs), "median_sr_len": min_sr_len,
-            "config": cfg.data,
-        }, indent=2))
+        with obs.span("preflight", cat="host"):
+            sr_lens = (np.array([len(r) for r in shorts]) if shorts
+                       else np.zeros(0))
+            min_sr_len = int(np.median(sr_lens)) if len(sr_lens) else 0
 
-    from proovread_tpu.pipeline import run_tasks
-    result = run_tasks(
-        cfg, mode, tasks, longs, shorts, utgs,
-        sam=args.sam, bam=args.bam, coverage=args.coverage,
-        lr_min_length=args.lr_min_length,
-        sampling=not args.no_sampling,
-        haplo_coverage=args.haplo_coverage)
+            # preflight (bin/proovread:457-464,586-592): catch mis-supplied
+            # inputs before any compile time is spent
+            if len(sr_lens) and sr_lens.max() > 1000 \
+                    and not args.ignore_sr_length:
+                print(f"error: short reads up to {int(sr_lens.max())}bp — "
+                      "is -s the right file? (--ignore-sr-length to "
+                      "proceed)", file=sys.stderr)
+                return 2
+            too_long = [r.id for r in longs if len(r.id) > 256]
+            if too_long:
+                print("error: read id longer than 256 chars: "
+                      f"{too_long[0]!r}", file=sys.stderr)
+                return 2
+            import jax
+            log.info("preflight: %d device(s), platform %s",
+                     jax.device_count(), jax.devices()[0].platform)
 
-    # -- reference output layout (bin/proovread:904-956) -----------------
-    from proovread_tpu.io.fasta import FastaWriter
-    from proovread_tpu.io.fastq import FastqWriter
+            mode = args.mode
+            if mode == "auto":
+                mode = mode_auto(min_sr_len, bool(utgs),
+                                 _have_subreads(longs),
+                                 sam=bool(args.sam), bam=bool(args.bam))
+            tasks = cfg.tasks(mode)
+            log.info("mode %s: tasks %s", mode, " ".join(tasks))
 
-    def _w(path, records, fq=True):
-        with open(os.path.join(outdir, path), "wb") as fh:
-            w = FastqWriter(fh) if fq else FastaWriter(fh)
-            for r in records:
-                w.write(r)
+            # parameter.log (bin/proovread:401-416)
+            with open(os.path.join(outdir, f"{name}.parameter.log"),
+                      "w") as fh:
+                fh.write(json.dumps({
+                    "argv": (sys.argv if argv is None
+                             else ["proovread-tpu"] + argv),
+                    "mode": mode, "tasks": tasks,
+                    "n_long_reads": len(longs),
+                    "n_short_reads": len(shorts),
+                    "n_unitigs": len(utgs), "median_sr_len": min_sr_len,
+                    "config": cfg.data,
+                }, indent=2))
 
-    _w(f"{name}.untrimmed.fq", result.untrimmed)
-    _w(f"{name}.trimmed.fq", result.trimmed)
-    _w(f"{name}.trimmed.fa", result.trimmed, fq=False)
-    if args.debug:
-        # per-read consensus debug dump (the role of bam2cns --debug's
-        # trace strings + filtered BAM, bin/bam2cns:271-295)
-        with open(os.path.join(outdir, f"{name}.debug.tsv"), "w") as fh:
-            fh.write("id\tlen\tmean_phred\tmasked_frac\n")
-            for r in result.untrimmed:
-                q = r.qual if r.qual is not None else np.zeros(0)
-                fh.write(f"{r.id}\t{len(r)}\t"
-                         f"{float(q.mean()) if len(q) else 0:.1f}\t"
-                         f"{float((q == 0).mean()) if len(q) else 0:.3f}\n")
-    with open(os.path.join(outdir, f"{name}.ignored.tsv"), "w") as fh:
-        for rid, why in result.ignored:
-            fh.write(f"{rid}\t{why}\n")
-    with open(os.path.join(outdir, f"{name}.chim.tsv"), "w") as fh:
-        for rid, f0, t0, s in result.chimera:
-            fh.write(f"{rid}\t{f0}\t{t0}\t{s:.3f}\n")
+        from proovread_tpu.pipeline import run_tasks
+        with obs.span("tasks", cat="mode", mode=mode):
+            result = run_tasks(
+                cfg, mode, tasks, longs, shorts, utgs,
+                sam=args.sam, bam=args.bam, coverage=args.coverage,
+                lr_min_length=args.lr_min_length,
+                sampling=not args.no_sampling,
+                haplo_coverage=args.haplo_coverage)
 
-    for rep in result.reports:
-        if rep.note:
-            # resilience events (ladder demotions, journal replays) carry
-            # their full story in the note — degraded output is
-            # attributable from the task summary alone
-            log.info("task %-16s %s", rep.task, rep.note)
-            continue
-        sat = ""
-        if rep.n_dropped_cap or rep.n_dropped_cov:
-            sat = (f"  dropped {rep.n_dropped_cap} cap /"
-                   f" {rep.n_dropped_cov} cov")
-        log.info("task %-16s masked/supported %5.1f%%  candidates %d%s",
-                 rep.task, rep.masked_frac * 100, rep.n_candidates, sat)
-    # the journal's job is done once the final outputs are on disk — it
-    # duplicates every corrected read, which is real space at the 315 Mb
-    # scale. --keep-temporary-files preserves it (reference semantics).
-    if ckpt_dir and os.path.isdir(ckpt_dir) \
-            and not args.keep_temporary_files:
-        import shutil
-        shutil.rmtree(ckpt_dir, ignore_errors=True)
-        log.info("checkpoint journal removed (outputs written; "
-                 "--keep-temporary-files preserves it)")
-    log.info("done: %d corrected, %d trimmed, %d ignored, %d chimera "
-             "(%.1fs)", len(result.untrimmed), len(result.trimmed),
-             len(result.ignored), len(result.chimera),
-             time.time() - t_start)
+        # -- reference output layout (bin/proovread:904-956) --------------
+        with obs.span("write-outputs", cat="io"):
+            from proovread_tpu.io.fasta import FastaWriter
+            from proovread_tpu.io.fastq import FastqWriter
+
+            def _w(path, records, fq=True):
+                with open(os.path.join(outdir, path), "wb") as fh:
+                    w = FastqWriter(fh) if fq else FastaWriter(fh)
+                    for r in records:
+                        w.write(r)
+
+            _w(f"{name}.untrimmed.fq", result.untrimmed)
+            _w(f"{name}.trimmed.fq", result.trimmed)
+            _w(f"{name}.trimmed.fa", result.trimmed, fq=False)
+            if args.debug:
+                # per-read consensus debug dump (the role of bam2cns
+                # --debug's trace strings + filtered BAM, bin/bam2cns:
+                # 271-295)
+                with open(os.path.join(outdir, f"{name}.debug.tsv"),
+                          "w") as fh:
+                    fh.write("id\tlen\tmean_phred\tmasked_frac\n")
+                    for r in result.untrimmed:
+                        q = r.qual if r.qual is not None else np.zeros(0)
+                        fh.write(
+                            f"{r.id}\t{len(r)}\t"
+                            f"{float(q.mean()) if len(q) else 0:.1f}\t"
+                            f"{float((q == 0).mean()) if len(q) else 0:.3f}"
+                            "\n")
+            with open(os.path.join(outdir, f"{name}.ignored.tsv"),
+                      "w") as fh:
+                for rid, why in result.ignored:
+                    fh.write(f"{rid}\t{why}\n")
+            with open(os.path.join(outdir, f"{name}.chim.tsv"), "w") as fh:
+                for rid, f0, t0, s in result.chimera:
+                    fh.write(f"{rid}\t{f0}\t{t0}\t{s:.3f}\n")
+
+        for rep in result.reports:
+            if rep.note:
+                # resilience events (ladder demotions, journal replays)
+                # carry their full story in the note — degraded output is
+                # attributable from the task summary alone
+                log.info("task %-16s %s", rep.task, rep.note)
+                continue
+            sat = ""
+            if rep.n_dropped_cap or rep.n_dropped_cov:
+                sat = (f"  dropped {rep.n_dropped_cap} cap /"
+                       f" {rep.n_dropped_cov} cov")
+            log.info("task %-16s masked/supported %5.1f%%  candidates %d%s",
+                     rep.task, rep.masked_frac * 100, rep.n_candidates, sat)
+        # the journal's job is done once the final outputs are on disk — it
+        # duplicates every corrected read, which is real space at the
+        # 315 Mb scale. --keep-temporary-files preserves it (reference
+        # semantics).
+        if ckpt_dir and os.path.isdir(ckpt_dir) \
+                and not args.keep_temporary_files:
+            import shutil
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+            log.info("checkpoint journal removed (outputs written; "
+                     "--keep-temporary-files preserves it)")
+        log.info("done: %d corrected, %d trimmed, %d ignored, %d chimera",
+                 len(result.untrimmed), len(result.trimmed),
+                 len(result.ignored), len(result.chimera))
     return 0
 
 

@@ -166,6 +166,14 @@ DEFAULTS: Dict[str, Any] = {
     # fault-injection spec (testing/faults.py grammar, e.g.
     # "compile@b0.p2;oom@b1"); null reads the PROOVREAD_FAULT env var
     "fault-spec": None,
+    # -- observability (proovread_tpu/obs; docs/OBSERVABILITY.md) ---------
+    # span-tree trace as Chrome trace-event JSONL (Perfetto-loadable);
+    # the CLI --trace flag overrides. null = tracing off (default)
+    "trace-file": None,
+    # typed KPI counters/gauges/histograms as one JSON object; the CLI
+    # --metrics-out flag overrides. null = no dump (metrics are still
+    # embedded in PipelineResult.metrics per run)
+    "metrics-out": None,
 }
 
 _COMMENT_RE = re.compile(r"^\s*//.*$", re.M)

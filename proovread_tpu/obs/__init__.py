@@ -1,0 +1,26 @@
+"""Observability layer: structured tracing + typed metrics.
+
+- ``obs.span(name, cat=..., **args)`` — hierarchical monotonic-clock
+  spans with device fencing and per-span compile/execute attribution
+  (``obs.trace``); serialized as Chrome trace-event JSONL (Perfetto).
+- ``obs.metrics`` — typed counter/gauge/histogram registry dumped as one
+  JSON object and embedded in ``PipelineResult.metrics``.
+
+Both are off by default (shared no-op singletons) and are enabled by the
+CLI ``--trace`` / ``--metrics-out`` flags, the ``trace-file`` /
+``metrics-out`` config keys, or programmatically via
+``obs.tracing()`` / ``obs.metrics.scope()``. See docs/OBSERVABILITY.md.
+"""
+
+from proovread_tpu.obs import metrics
+from proovread_tpu.obs.trace import (NOOP_SPAN, Span, Tracer, count_retrace,
+                                     enabled, span, tracing)
+from proovread_tpu.obs.trace import current as current_tracer
+from proovread_tpu.obs.trace import install as install_tracer
+from proovread_tpu.obs.trace import uninstall as uninstall_tracer
+
+__all__ = [
+    "metrics", "span", "Span", "Tracer", "tracing", "enabled",
+    "count_retrace", "current_tracer", "install_tracer", "uninstall_tracer",
+    "NOOP_SPAN",
+]

@@ -1,0 +1,244 @@
+"""Typed metrics registry: counters, gauges, histograms.
+
+The PR-1 saturation/demotion KPIs lived in free-text log lines
+("[dropped: 12 cap, 3 cov]") that no scraper could consume; this module
+gives every KPI a typed, labeled series with a unit, dumped as ONE JSON
+object (``--metrics-out FILE``) and embedded in
+``PipelineResult.metrics``. See docs/OBSERVABILITY.md for the catalog.
+
+Usage — instrumentation sites call the module-level helpers, which no-op
+(shared :data:`NOOP` sink) while no registry is installed::
+
+    from proovread_tpu.obs import metrics
+    metrics.counter("resilience_demotions", unit="events").inc(
+        1, to_rung="eager")
+
+Labels are plain keyword strings; each distinct label set is its own
+series. ``Pipeline.run`` opens a :func:`scope` — reusing the registry the
+CLI installed for the whole run, or a fresh one for programmatic callers
+— so ``result.metrics`` is always populated.
+
+Serialized shape (``schema`` guards readers)::
+
+    {"schema": 1,
+     "counters":   {name: {"unit": u, "help": h,
+                           "series": [{"labels": {...}, "value": n}]}},
+     "gauges":     {... same shape ...},
+     "histograms": {name: {"unit": u, "help": h,
+                           "series": [{"labels": {...}, "count": n,
+                                       "sum": s, "min": a, "max": b}]}}}
+"""
+
+from __future__ import annotations
+
+import json
+from contextlib import contextmanager
+from typing import Any, Dict, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+
+def _lkey(labels: Dict[str, Any]) -> Tuple:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = "metric"
+
+    def __init__(self, name: str, unit: str, help: str):    # noqa: A002
+        self.name = name
+        self.unit = unit
+        self.help = help
+        self.series: Dict[Tuple, Any] = {}
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> "Counter":
+        k = _lkey(labels)
+        self.series[k] = self.series.get(k, 0) + n
+        return self
+
+    def value(self, **labels) -> float:
+        return self.series.get(_lkey(labels), 0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> "Gauge":
+        self.series[_lkey(labels)] = v
+        return self
+
+    def value(self, **labels) -> float:
+        return self.series.get(_lkey(labels), 0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def observe(self, v: float, **labels) -> "Histogram":
+        k = _lkey(labels)
+        s = self.series.get(k)
+        if s is None:
+            s = self.series[k] = {"count": 0, "sum": 0.0,
+                                  "min": None, "max": None}
+        s["count"] += 1
+        s["sum"] += v
+        s["min"] = v if s["min"] is None else min(s["min"], v)
+        s["max"] = v if s["max"] is None else max(s["max"], v)
+        return self
+
+    def value(self, **labels) -> Dict[str, Any]:
+        return self.series.get(
+            _lkey(labels), {"count": 0, "sum": 0.0, "min": None,
+                            "max": None})
+
+
+class _NoopMetric:
+    """Shared sink returned by the module helpers when no registry is
+    installed: observability off costs one ``is None`` check."""
+
+    __slots__ = ()
+
+    def inc(self, n: float = 1, **labels):
+        return self
+
+    def set(self, v: float, **labels):
+        return self
+
+    def observe(self, v: float, **labels):
+        return self
+
+    def value(self, **labels):
+        return 0
+
+
+NOOP = _NoopMetric()
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, unit: str, help: str):    # noqa: A002
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name, unit, help)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {cls.kind}")
+        else:
+            # first registration with a unit/help wins; later bare calls
+            # (hot paths skip the strings) must not erase them
+            if unit and not m.unit:
+                m.unit = unit
+            if help and not m.help:
+                m.help = help
+        return m
+
+    def counter(self, name: str, unit: str = "",
+                help: str = "") -> Counter:                  # noqa: A002
+        return self._get(Counter, name, unit, help)
+
+    def gauge(self, name: str, unit: str = "",
+              help: str = "") -> Gauge:                      # noqa: A002
+        return self._get(Gauge, name, unit, help)
+
+    def histogram(self, name: str, unit: str = "",
+                  help: str = "") -> Histogram:              # noqa: A002
+        return self._get(Histogram, name, unit, help)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Deep-copy the series state for rollback. The resilience ladder
+        rewinds a failed attempt's TaskReports and sampler rotation; its
+        KPI counters must rewind with them or retried buckets
+        double-count (one schema means one truth)."""
+        return {name: {k: (dict(v) if isinstance(v, dict) else v)
+                       for k, v in m.series.items()}
+                for name, m in self._metrics.items()}
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        """Roll series back to ``snap``. Metrics registered after the
+        snapshot stay registered (catalog stability) with empty series."""
+        for name, m in self._metrics.items():
+            saved = snap.get(name)
+            m.series = ({} if saved is None else
+                        {k: (dict(v) if isinstance(v, dict) else v)
+                         for k, v in saved.items()})
+
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"schema": SCHEMA_VERSION, "counters": {},
+                               "gauges": {}, "histograms": {}}
+        for m in self._metrics.values():
+            series = []
+            for k, v in sorted(m.series.items()):
+                entry: Dict[str, Any] = {"labels": dict(k)}
+                if m.kind == "histogram":
+                    entry.update(v)
+                else:
+                    entry["value"] = v
+                series.append(entry)
+            out[m.kind + "s"][m.name] = {
+                "unit": m.unit, "help": m.help, "series": series}
+        return out
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.as_dict(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+
+_current: Optional[MetricsRegistry] = None
+
+
+def current() -> Optional[MetricsRegistry]:
+    return _current
+
+
+def install(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry:
+    global _current
+    _current = reg if reg is not None else MetricsRegistry()
+    return _current
+
+
+def uninstall() -> None:
+    global _current
+    _current = None
+
+
+@contextmanager
+def scope(registry: Optional[MetricsRegistry] = None):
+    """Yield the active registry, or install a fresh (or given) one for
+    the block. ``Pipeline.run`` wraps itself in this so CLI-installed
+    registries accumulate across stages while bare programmatic runs
+    still get per-run metrics."""
+    global _current
+    if registry is None and _current is not None:
+        yield _current
+        return
+    prev = _current
+    _current = registry if registry is not None else MetricsRegistry()
+    try:
+        yield _current
+    finally:
+        _current = prev
+
+
+def counter(name: str, unit: str = "", help: str = ""):      # noqa: A002
+    return (_current.counter(name, unit, help)
+            if _current is not None else NOOP)
+
+
+def gauge(name: str, unit: str = "", help: str = ""):        # noqa: A002
+    return (_current.gauge(name, unit, help)
+            if _current is not None else NOOP)
+
+
+def histogram(name: str, unit: str = "", help: str = ""):    # noqa: A002
+    return (_current.histogram(name, unit, help)
+            if _current is not None else NOOP)

@@ -1,0 +1,109 @@
+"""End-to-end observability smoke (``make trace-smoke``).
+
+Runs a small full CLI correction with ``--trace`` and ``--metrics-out``
+and validates both artifacts: the trace must parse against the Chrome
+trace-event schema with its root span ≥95% covered by children and every
+bucket span carrying the compile/execute split; the metrics JSON must
+parse against the registry schema and contain the KPI counter catalog.
+
+Workload: the F.antasticus reference sample when present
+(``/root/reference/sample``), else a synthetic genome with the same
+simulators ``bench.py`` uses — the smoke must run on any machine with the
+package, CPU included (interpret-mode Pallas), in ~a minute.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REQUIRED_COUNTERS = (
+    "admission_dropped_cov", "admission_dropped_cap",
+    "resilience_demotions", "checkpoint_journal_writes",
+    "mask_shortcut_hits", "reads_processed", "bases_processed",
+)
+
+_SAMPLE = "/root/reference/sample"
+
+
+def _log(msg: str) -> None:
+    print(f"[trace-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def _write_fastq(path: str, records) -> None:
+    from proovread_tpu.io.fastq import FastqWriter
+    with open(path, "wb") as fh:
+        w = FastqWriter(fh)
+        for r in records:
+            w.write(r)
+
+
+def _workload(tmp: str):
+    """(long_fq, short_fq) paths; tiny but multi-bucket."""
+    from proovread_tpu.io.simulate import (random_genome,
+                                           simulate_long_reads,
+                                           simulate_short_reads)
+    if os.path.isdir(_SAMPLE):
+        from proovread_tpu.io import fasta, fastq
+        from proovread_tpu.ops.encode import encode_ascii
+        genome = encode_ascii(next(iter(fasta.FastaReader(
+            f"{_SAMPLE}/F.antasticus_genome.fa"))).seq)
+        longs = list(fastq.FastqReader(
+            f"{_SAMPLE}/F.antasticus_long_error.fq"))[:24]
+        _log(f"sample workload: {len(longs)} F.antasticus reads")
+    else:
+        genome = random_genome(3000, seed=5)
+        longs, _truth = simulate_long_reads(
+            genome, total_bases=5000, mean_len=700, min_len=400,
+            seed=6)
+        _log(f"synthetic workload: {len(longs)} simulated reads "
+             "(reference sample absent)")
+    srs = simulate_short_reads(genome, 30.0, seed=7)
+    lp = os.path.join(tmp, "long.fq")
+    sp = os.path.join(tmp, "short.fq")
+    _write_fastq(lp, longs)
+    _write_fastq(sp, srs)
+    return lp, sp
+
+
+def main(argv=None) -> int:
+    from proovread_tpu.cli import main as cli_main
+    from proovread_tpu.obs.validate import (ValidationError,
+                                            validate_metrics,
+                                            validate_trace)
+
+    with tempfile.TemporaryDirectory(prefix="proovread_smoke_") as tmp:
+        lp, sp = _workload(tmp)
+        cfgp = os.path.join(tmp, "smoke.cfg")
+        with open(cfgp, "w") as fh:
+            json.dump({"batch-reads": 8, "device-chunk": 128,
+                       "seq-filter": {"--min-length": 150}}, fh)
+        out = os.path.join(tmp, "out")
+        trace = os.path.join(tmp, "run.trace.jsonl")
+        mets = os.path.join(tmp, "run.metrics.json")
+        _log("running CLI with --trace/--metrics-out")
+        rc = cli_main(["-l", lp, "-s", sp, "-p", out, "-m", "sr-noccs",
+                       "-c", cfgp, "--trace", trace,
+                       "--metrics-out", mets])
+        if rc != 0:
+            _log(f"CLI exited {rc}")
+            return 1
+        try:
+            tstats = validate_trace(trace, min_coverage=0.95)
+            mstats = validate_metrics(mets, require=REQUIRED_COUNTERS)
+        except ValidationError as e:
+            _log(f"FAILED: {e}")
+            return 1
+        if tstats["n_buckets"] < 1:
+            _log("FAILED: no bucket spans in trace")
+            return 1
+        _log(f"trace OK: {json.dumps(tstats)}")
+        _log(f"metrics OK: {json.dumps(mstats)}")
+        _log("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,311 @@
+"""Hierarchical span tracer: one clock (``time.monotonic``), one schema.
+
+The pipeline's timing story used to be scattered wall-clock timer pairs
+logged as free text; this module replaces them with a single span tree — run → mode/task → bucket → (ladder) attempt → pass → kernel —
+recorded against the monotonic clock and serialized two ways:
+
+- **Chrome trace events** (:meth:`Tracer.write_chrome`): one JSON object
+  per line (``X`` complete events plus one ``M`` process-name record).
+  Perfetto's JSON trace reader accepts concatenated objects, so the file
+  loads directly at https://ui.perfetto.dev (open → select the file).
+- **Summary table** (:meth:`Tracer.summary_lines`): per-(depth, name)
+  aggregation rendered at end of run via ``log.info``.
+
+**Device fencing.** XLA dispatch is asynchronous: the Python-side duration
+of an enqueue says nothing about device time. A span that launches device
+work calls :meth:`Span.fence` with the output arrays; at span exit (and
+only while tracing is enabled) the tracer runs ``jax.block_until_ready``
+on them, so device time lands in the span that launched the work. With
+tracing disabled, ``fence`` is a no-op and the async pipeline is
+untouched — observability off costs only a dict lookup per span site.
+
+**Compile vs execute.** A module-level ``jax.monitoring`` duration
+listener (installed once, dispatching to the *active* tracer) attributes
+every ``backend_compile_duration`` event to all currently-open spans, so
+each bucket/pass span carries ``compile_ms`` and ``execute_ms``
+(= duration − compile) in its args: the first bucket at a fresh shape
+shows the compile cost, steady-state buckets show ~0. Only the backend
+event is attributed because the trace/lowering events
+(``jaxpr_trace_duration`` etc.) nest — an outer jit's duration includes
+its inner jits', so summing them double-counts and can exceed wall time.
+Backend compiles also count into :attr:`Tracer.n_compiles` (the
+compile-cache-miss counter); Python-level retraces are counted by
+:func:`count_retrace` hooks placed inside jitted function bodies (they
+execute once per trace, including persistent-cache hits that skip the
+backend compile).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("proovread_tpu")
+
+_COMPILE_EVENT_PREFIX = "/jax/core/compile/"
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+
+# span categories whose args always carry the compile/execute split
+_SPLIT_CATS = frozenset(("bucket", "attempt", "pass", "kernel"))
+
+
+class _NoopSpan:
+    """Shared do-nothing span: returned by :func:`span` while tracing is
+    off, so instrumentation sites cost one module lookup and one attribute
+    call. ``fence`` returns its argument unblocked — the async dispatch
+    behavior of an untraced run is byte-for-byte the pre-obs pipeline."""
+
+    __slots__ = ()
+    dur_s = 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def fence(self, obj):
+        return obj
+
+    def set(self, **args):
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+
+_tracer: Optional["Tracer"] = None
+_hook_installed = False
+
+
+def current() -> Optional["Tracer"]:
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def install(tracer: Optional["Tracer"] = None) -> "Tracer":
+    """Make ``tracer`` (or a fresh one) the active tracer and hook the
+    jax.monitoring compile listener (once per process)."""
+    global _tracer
+    _tracer = tracer if tracer is not None else Tracer()
+    _install_monitoring_hook()
+    return _tracer
+
+
+def uninstall() -> None:
+    global _tracer
+    _tracer = None
+
+
+@contextmanager
+def tracing(tracer: Optional["Tracer"] = None):
+    """Scoped tracer installation (tests, bench attribution runs)."""
+    global _tracer
+    prev = _tracer
+    t = install(tracer)
+    try:
+        yield t
+    finally:
+        _tracer = prev
+
+
+def span(name: str, cat: str = "span", **args):
+    """Open a span on the active tracer; a shared no-op when tracing is
+    off. Usage::
+
+        with obs.span("bwa-sr-1", cat="pass", bucket=gi) as sp:
+            out = launch(...)
+            sp.fence(out)       # device time lands in this span
+    """
+    t = _tracer
+    if t is None:
+        return NOOP_SPAN
+    return Span(t, name, cat, args)
+
+
+def count_retrace(fn_name: str) -> None:
+    """Retrace hook for jitted function bodies: the body executes exactly
+    once per (re)trace, so calling this at its top counts jit-cache
+    misses at the Python level — including ones served from the
+    persistent XLA cache, which skip backend_compile but still retrace."""
+    t = _tracer
+    if t is not None:
+        t.n_retraces += 1
+    from proovread_tpu.obs import metrics as _metrics
+    reg = _metrics.current()
+    if reg is not None:
+        reg.counter("jax_retraces", unit="traces",
+                    help="Python retraces of jitted pipeline functions "
+                         "(count_retrace hooks)").inc(1, fn=fn_name)
+
+
+def _install_monitoring_hook() -> None:
+    """Register ONE process-wide jax.monitoring listener that dispatches
+    to whatever tracer is active (jax has no unregister API, so a
+    per-tracer listener would leak)."""
+    global _hook_installed
+    if _hook_installed:
+        return
+    _hook_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_duration(event, duration, **kw):
+            t = _tracer
+            if t is not None and event == _BACKEND_COMPILE:
+                t._on_compile(event, float(duration))
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:                                   # noqa: BLE001
+        # jax absent or too old: spans still work, compile split reads 0
+        log.debug("jax.monitoring unavailable — compile attribution off")
+
+
+class Span:
+    """One live span. Created via :func:`span` / :meth:`Tracer.span`;
+    records a Chrome ``X`` (complete) event at exit."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "depth", "compile_s",
+                 "dur_s", "_start", "_fence_obj")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.compile_s = 0.0
+        self.dur_s = 0.0
+        self._fence_obj = None
+
+    def set(self, **args):
+        self.args.update(args)
+        return self
+
+    def fence(self, obj):
+        """Block on ``obj`` (any jax pytree) at span exit so its device
+        time is attributed here. Returns ``obj`` unchanged."""
+        self._fence_obj = obj
+        return obj
+
+    def __enter__(self):
+        t = self._tracer
+        self.depth = len(t._stack)
+        t._stack.append(self)
+        self._start = t._clock()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t = self._tracer
+        if self._fence_obj is not None and exc_type is None:
+            try:
+                import jax
+                jax.block_until_ready(self._fence_obj)
+            except Exception:                           # noqa: BLE001
+                pass                # fence is attribution, never a fault
+        end = t._clock()
+        if t._stack and t._stack[-1] is self:
+            t._stack.pop()
+        elif self in t._stack:      # mismatched exit (exception unwinding)
+            t._stack.remove(self)
+        self.dur_s = end - self._start
+        args = dict(self.args)
+        args["depth"] = self.depth
+        if self.compile_s > 0 or self.cat in _SPLIT_CATS:
+            # clamp: a backend compile can straddle a span boundary when
+            # dispatch blocks lazily — never report compile > duration
+            comp = min(self.compile_s, self.dur_s)
+            args["compile_ms"] = round(comp * 1e3, 3)
+            args["execute_ms"] = round(
+                max(self.dur_s - comp, 0.0) * 1e3, 3)
+        if exc_type is not None:
+            args["error"] = exc_type.__name__
+        t.events.append({
+            "name": self.name, "cat": self.cat, "ph": "X",
+            "ts": round((self._start - t.t0) * 1e6, 1),
+            "dur": round(self.dur_s * 1e6, 1),
+            "pid": 1, "tid": 1, "args": args,
+        })
+        return False
+
+
+class Tracer:
+    """Span collector for one run. Install with :func:`install` /
+    :func:`tracing`; pipeline code only ever calls :func:`span`."""
+
+    def __init__(self):
+        self._clock = time.monotonic
+        self.t0 = self._clock()
+        self.events: List[Dict[str, Any]] = []
+        self._stack: List[Span] = []
+        self.n_compiles = 0         # backend_compile events (cache misses)
+        self.n_retraces = 0         # count_retrace hook firings
+        self.compile_s = 0.0        # total backend-compile seconds
+
+    def span(self, name: str, cat: str = "span", **args) -> Span:
+        return Span(self, name, cat, args)
+
+    def _on_compile(self, event: str, duration: float) -> None:
+        if event == _BACKEND_COMPILE:
+            self.n_compiles += 1
+        self.compile_s += duration
+        for sp in self._stack:      # attribute to every open span: the
+            sp.compile_s += duration  # bucket split must include children
+
+    # -- serialization ----------------------------------------------------
+    def write_chrome(self, path: str) -> None:
+        """Chrome trace-event JSONL: one event object per line (Perfetto
+        loads the concatenated-objects form directly)."""
+        with open(path, "w") as fh:
+            fh.write(json.dumps({
+                "name": "process_name", "ph": "M", "pid": 1, "tid": 1,
+                "args": {"name": "proovread-tpu"}}) + "\n")
+            for ev in self.events:
+                fh.write(json.dumps(ev) + "\n")
+
+    def phase_totals(self) -> Dict[str, Dict[str, float]]:
+        """Per-category aggregation (bench's per-phase breakdown)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for ev in self.events:
+            ph = out.setdefault(ev["cat"],
+                                {"count": 0, "total_s": 0.0,
+                                 "compile_s": 0.0})
+            ph["count"] += 1
+            ph["total_s"] += ev["dur"] / 1e6
+            ph["compile_s"] += ev["args"].get("compile_ms", 0.0) / 1e3
+        for ph in out.values():
+            ph["total_s"] = round(ph["total_s"], 4)
+            ph["compile_s"] = round(ph["compile_s"], 4)
+        return out
+
+    def summary_lines(self) -> List[str]:
+        """End-of-run table: spans aggregated by (depth, name, cat),
+        printed in first-start order with tree indentation."""
+        agg: Dict[tuple, List[float]] = {}
+        first_ts: Dict[tuple, float] = {}
+        for ev in self.events:
+            key = (ev["args"].get("depth", 0), ev["name"], ev["cat"])
+            a = agg.setdefault(key, [0, 0.0, 0.0])
+            a[0] += 1
+            a[1] += ev["dur"] / 1e6
+            a[2] += ev["args"].get("compile_ms", 0.0) / 1e3
+            ts = ev["ts"]
+            if key not in first_ts or ts < first_ts[key]:
+                first_ts[key] = ts
+        lines = [f"{'span':<40}{'n':>5}{'total_s':>10}"
+                 f"{'compile_s':>11}{'execute_s':>11}"]
+        for key in sorted(agg, key=lambda k: (first_ts[k], k[0])):
+            depth, name, _cat = key
+            n, dur, comp = agg[key]
+            lines.append(f"{'  ' * depth + name:<40}{n:>5}{dur:>10.3f}"
+                         f"{comp:>11.3f}{dur - comp:>11.3f}")
+        lines.append(
+            f"jax: {self.n_compiles} backend compile(s), "
+            f"{self.n_retraces} retrace(s), "
+            f"{self.compile_s:.3f}s total compile time")
+        return lines

@@ -1,0 +1,172 @@
+"""Schema validation for the observability artifacts.
+
+``validate_trace`` checks the Chrome trace-event JSONL written by
+``Tracer.write_chrome`` (one JSON object per line, ``X`` complete events
+plus ``M`` metadata), verifies the span tree (depths, durations, the
+compile/execute split on bucket spans) and computes the root-coverage
+statistic the acceptance bar cares about: the fraction of the root span's
+wall time covered by its direct children. ``validate_metrics`` checks the
+metrics JSON against the ``obs.metrics`` schema.
+
+Both are importable (``make trace-smoke``, tests) and runnable::
+
+    python -m proovread_tpu.obs.validate --trace run.trace.jsonl \
+        --metrics run.metrics.json --min-coverage 0.95 \
+        --require admission_dropped_cov,resilience_demotions
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, Tuple
+
+from proovread_tpu.obs.metrics import SCHEMA_VERSION
+
+_REQUIRED_X = ("name", "cat", "ph", "ts", "dur", "pid", "tid", "args")
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def _fail(msg: str):
+    raise ValidationError(msg)
+
+
+def validate_trace(path: str, min_coverage: float = 0.0) -> Dict[str, Any]:
+    """Validate a trace JSONL file; returns summary stats."""
+    events = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError as e:
+                _fail(f"{path}:{lineno}: not a JSON object ({e})")
+            if not isinstance(ev, dict) or "ph" not in ev:
+                _fail(f"{path}:{lineno}: event missing 'ph'")
+            if ev["ph"] == "M":
+                continue                    # metadata record
+            if ev["ph"] != "X":
+                _fail(f"{path}:{lineno}: unexpected phase {ev['ph']!r} "
+                      "(writer emits only X/M)")
+            missing = [k for k in _REQUIRED_X if k not in ev]
+            if missing:
+                _fail(f"{path}:{lineno}: X event missing {missing}")
+            if not isinstance(ev["args"], dict):
+                _fail(f"{path}:{lineno}: args must be an object")
+            if not isinstance(ev["args"].get("depth"), int):
+                _fail(f"{path}:{lineno}: args.depth missing/not int")
+            for k in ("ts", "dur"):
+                if not isinstance(ev[k], (int, float)) or ev[k] < 0:
+                    _fail(f"{path}:{lineno}: {k} must be a >=0 number")
+            events.append(ev)
+    if not events:
+        _fail(f"{path}: no span events")
+
+    buckets = [e for e in events if e["cat"] == "bucket"]
+    for b in buckets:
+        if "compile_ms" not in b["args"] or "execute_ms" not in b["args"]:
+            _fail(f"{path}: bucket span {b['name']!r} lacks the "
+                  "compile_ms/execute_ms split")
+
+    roots = [e for e in events if e["args"]["depth"] == 0]
+    if not roots:
+        _fail(f"{path}: no depth-0 root span")
+    root = max(roots, key=lambda e: e["dur"])
+    r0, r1 = root["ts"], root["ts"] + root["dur"]
+    kids = [e for e in events
+            if e["args"]["depth"] == 1 and r0 <= e["ts"] <= r1]
+    coverage = (min(1.0, sum(k["dur"] for k in kids) / root["dur"])
+                if root["dur"] > 0 else 1.0)
+    if coverage < min_coverage:
+        _fail(f"{path}: root span {root['name']!r} children cover "
+              f"{coverage:.1%} of its wall time (< {min_coverage:.0%})")
+    return {
+        "n_events": len(events),
+        "root": root["name"],
+        "wall_s": round(root["dur"] / 1e6, 3),
+        "coverage": round(coverage, 4),
+        "n_buckets": len(buckets),
+        "compile_s": round(sum(
+            e["args"].get("compile_ms", 0.0) for e in events
+            if e["args"]["depth"] == 0) / 1e3, 3),
+    }
+
+
+def validate_metrics(path: str,
+                     require: Iterable[str] = ()) -> Dict[str, Any]:
+    """Validate a metrics JSON file; ``require`` lists counter names that
+    must be present (the pipeline pre-declares its KPI catalog, so even
+    zero-valued counters appear)."""
+    with open(path) as fh:
+        try:
+            d = json.load(fh)
+        except json.JSONDecodeError as e:
+            _fail(f"{path}: not JSON ({e})")
+    if not isinstance(d, dict) or d.get("schema") != SCHEMA_VERSION:
+        _fail(f"{path}: schema != {SCHEMA_VERSION}")
+    n_series = 0
+    for section in ("counters", "gauges", "histograms"):
+        sec = d.get(section)
+        if not isinstance(sec, dict):
+            _fail(f"{path}: missing section {section!r}")
+        for name, m in sec.items():
+            for k in ("unit", "help", "series"):
+                if k not in m:
+                    _fail(f"{path}: {section}.{name} missing {k!r}")
+            for s in m["series"]:
+                n_series += 1
+                if not isinstance(s.get("labels"), dict):
+                    _fail(f"{path}: {section}.{name} series lacks labels")
+                if section == "histograms":
+                    for k in ("count", "sum", "min", "max"):
+                        if k not in s:
+                            _fail(f"{path}: histogram {name} series "
+                                  f"missing {k!r}")
+                elif not isinstance(s.get("value"), (int, float)):
+                    _fail(f"{path}: {section}.{name} series value "
+                          "missing/not numeric")
+    missing = [n for n in require if n not in d["counters"]]
+    if missing:
+        _fail(f"{path}: required counters absent: {missing}")
+    return {"n_counters": len(d["counters"]),
+            "n_gauges": len(d["gauges"]),
+            "n_histograms": len(d["histograms"]),
+            "n_series": n_series}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proovread-tpu-obs-validate",
+        description="Validate --trace / --metrics-out artifacts.")
+    ap.add_argument("--trace", help="trace-event JSONL file")
+    ap.add_argument("--metrics", help="metrics JSON file")
+    ap.add_argument("--min-coverage", type=float, default=0.0,
+                    help="minimum root-span child coverage (0..1)")
+    ap.add_argument("--require", default="",
+                    help="comma-separated counter names that must exist")
+    args = ap.parse_args(argv)
+    if not (args.trace or args.metrics):
+        ap.error("need --trace and/or --metrics")
+    try:
+        if args.trace:
+            stats = validate_trace(args.trace, args.min_coverage)
+            print(f"trace OK: {json.dumps(stats)}")
+        if args.metrics:
+            req: Tuple[str, ...] = tuple(
+                s for s in args.require.split(",") if s)
+            stats = validate_metrics(args.metrics, require=req)
+            print(f"metrics OK: {json.dumps(stats)}")
+    except ValidationError as e:
+        print(f"validation FAILED: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from proovread_tpu import obs
 from proovread_tpu.align.sw import OP_D, OP_I, OP_M, OP_NONE
 from proovread_tpu.ops.encode import GAP
 from proovread_tpu.ops.pileup import Pileup
@@ -72,6 +73,7 @@ def fused_accumulate(
     taboo_abs: int = 0,
     min_aln_length: int = 50,
 ) -> Pileup:
+    obs.count_retrace("fused_accumulate")   # fires once per jit retrace
     B, L, S = pile.counts.shape
     K = pile.ins_len_votes.shape[-1]
     R, T = ops_rev.shape

@@ -34,6 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from proovread_tpu import obs
 from proovread_tpu.align import bsw, dseed
 from proovread_tpu.align.params import AlignParams
 from proovread_tpu.consensus.params import NCSCORE_CONSTANT, ConsensusParams
@@ -932,11 +933,18 @@ def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
                 budget_r=budget_r, haplo=haplo)
 
 
+def _fused_pass_entry(*args, **kw):
+    # retrace counter (obs): this body runs once per jit-cache miss — a
+    # fresh (shape, static-arg) combination — never at steady state
+    obs.count_retrace("fused_pass")
+    return _fused_pass_body(*args, **kw)
+
+
 _fused_pass = functools.partial(
     jax.jit,
     static_argnames=("m", "W", "CH", "n_chunks", "ap", "cns", "interpret",
                      "collect", "haplo"),
-)(_fused_pass_body)
+)(_fused_pass_entry)
 
 
 @functools.partial(
@@ -968,6 +976,7 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
     early/late iterations mask differently). Returns the final read state
     plus stacked per-iteration (frac, n_cand, n_admitted) and the number
     of iterations actually run."""
+    obs.count_retrace("fused_iterations")
     B = codes.shape[0]
 
     def one_pass(codes, qual, lengths, mask_cols, it):
@@ -1096,8 +1105,6 @@ class DeviceCorrector:
     ):
         """One correction pass (dynamic chunk count; the multi-pass loop
         without per-pass host syncs is :func:`fused_iterations`)."""
-        import time as _time
-        _t0 = _time.time()
         B, Lp = codes.shape
         m = q_codes.shape[1]
         W = bsw.band_lanes(ap)
@@ -1107,18 +1114,21 @@ class DeviceCorrector:
             map_codes = jnp.where(mask_cols, jnp.int8(N), codes)
         else:
             map_codes = codes
-        index = dseed.device_index(map_codes, lengths, ap.min_seed_len)
-        cand = dseed.probe_candidates(
-            index, q_codes, q_lengths, rc_codes, ap,
-            stride=seed_stride, min_votes=seed_min_votes)
-        sread, strand, lread, diag, n_valid = dseed.compact_candidates(cand)
-        try:        # overlap the RPC with the device still seeding
-            n_valid.copy_to_host_async()
-        except AttributeError:
-            pass
-        _t1 = _time.time()
+        # 'seed' span: fencing (tracing only) pins the seeding kernels'
+        # device time here instead of the n_cand sync below
+        with obs.span("seed", cat="kernel") as sp:
+            index = dseed.device_index(map_codes, lengths, ap.min_seed_len)
+            cand = dseed.probe_candidates(
+                index, q_codes, q_lengths, rc_codes, ap,
+                stride=seed_stride, min_votes=seed_min_votes)
+            sread, strand, lread, diag, n_valid = \
+                dseed.compact_candidates(cand)
+            try:        # overlap the RPC with the device still seeding
+                n_valid.copy_to_host_async()
+            except AttributeError:
+                pass
+            sp.fence(n_valid)
         n_cand = int(n_valid)                       # host sync #1
-        _t2 = _time.time()
 
         map_flat = map_codes.reshape(-1)
         ignore_flat = None
@@ -1139,18 +1149,18 @@ class DeviceCorrector:
         sread, strand, lread, diag = _pad_candidates(
             sread, strand, lread, diag, R_need)
 
-        call, n_admitted, n_eligible, scalars, slabs, hpl = _fused_pass(
-            map_flat, ignore_flat, codes, qual, lengths,
-            q_codes, rc_codes, q_qual, q_lengths,
-            sread, strand, lread, diag,
-            jnp.asarray(n_cand, jnp.int32),
-            m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
-            interpret=self.interpret, collect=collect_aln,
-            budget_r=budget_r, haplo=haplo)
-        log.debug("correct_pass: seed-enqueue %.0f ms, n_cand sync %.0f ms, "
-                  "fused-enqueue %.0f ms (n_cand=%d, chunks=%d)",
-                  (_t1 - _t0) * 1e3, (_t2 - _t1) * 1e3,
-                  (_time.time() - _t2) * 1e3, n_cand, n_chunks)
+        with obs.span("consense", cat="kernel", n_cand=n_cand,
+                      chunks=n_chunks) as sp:
+            call, n_admitted, n_eligible, scalars, slabs, hpl = _fused_pass(
+                map_flat, ignore_flat, codes, qual, lengths,
+                q_codes, rc_codes, q_qual, q_lengths,
+                sread, strand, lread, diag,
+                jnp.asarray(n_cand, jnp.int32),
+                m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
+                interpret=self.interpret, collect=collect_aln,
+                budget_r=budget_r, haplo=haplo)
+            sp.fence(call)
+        log.debug("correct_pass: n_cand=%d, chunks=%d", n_cand, n_chunks)
         stats = DevicePassStats(n_candidates=n_cand, n_admitted=n_admitted,
                                 n_eligible=n_eligible)
         if haplo and not collect_aln:

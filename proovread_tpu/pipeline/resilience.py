@@ -65,6 +65,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from proovread_tpu.obs import metrics as obs_metrics
 from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.testing.faults import (BucketTimeout, InjectedFault,
                                           WallClockExceeded)
@@ -355,6 +356,8 @@ class CheckpointJournal:
             json.dump(entry, fh)
         os.replace(dst + ".tmp", dst)
         self.entries[key] = entry
+        obs_metrics.counter("checkpoint_journal_writes",
+                            unit="buckets").inc()
 
     # -- read -------------------------------------------------------------
     def get(self, key: str):
@@ -383,4 +386,6 @@ class CheckpointJournal:
             note=rep.get("note", ""),
         ) for rep in e["reports"]]
         self.hits += 1
+        obs_metrics.counter("checkpoint_journal_replays",
+                            unit="buckets").inc()
         return results, chim, reports, e["sampler_first_chunk"]

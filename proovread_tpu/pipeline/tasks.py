@@ -15,6 +15,7 @@ import logging
 import time
 from typing import List, Optional, Sequence
 
+from proovread_tpu import obs
 from proovread_tpu.config import Config
 from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.pipeline.driver import (Pipeline, PipelineConfig,
@@ -114,11 +115,13 @@ def _apply_siamaera(cfg: Config, result: PipelineResult) -> None:
     if cfg.data.get("siamaera", {}) is None:
         return
     from proovread_tpu.pipeline.siamaera import siamaera_filter
-    t0 = time.time()
-    trimmed, stats = siamaera_filter(result.trimmed)
+    t0 = time.monotonic()
+    with obs.span("siamaera", cat="task"):
+        trimmed, stats = siamaera_filter(result.trimmed)
     result.trimmed = trimmed
     log.info("siamaera: %d checked, %d trimmed, %d dropped (%.1fs)",
-             stats.checked, stats.trimmed, stats.dropped, time.time() - t0)
+             stats.checked, stats.trimmed, stats.dropped,
+             time.monotonic() - t0)
 
 
 def run_tasks(
@@ -152,18 +155,19 @@ def run_tasks(
             log.info("ccs-1: ids are not PacBio subreads, skipping "
                      "(-noccs fallback, bin/proovread:1512-1517)")
         else:
-            t0 = time.time()
+            t0 = time.monotonic()
             ccs_cfg = cfg.get("ccs") or {}
-            longs, st = ccs_correct(
-                longs,
-                min_subreads=int(ccs_cfg.get("--min-subreads", 2)),
-                window=int(ccs_cfg.get("--window", 512)),
-                overlap=int(ccs_cfg.get("--overlap", 64)),
-                batch_refs=int(ccs_cfg.get("--batch-refs", 256)))
+            with obs.span("ccs-1", cat="task"):
+                longs, st = ccs_correct(
+                    longs,
+                    min_subreads=int(ccs_cfg.get("--min-subreads", 2)),
+                    window=int(ccs_cfg.get("--window", 512)),
+                    overlap=int(ccs_cfg.get("--overlap", 64)),
+                    batch_refs=int(ccs_cfg.get("--batch-refs", 256)))
             reports.append(TaskReport("ccs-1", 0.0, 0, st.primary))
             log.info("ccs-1: %d primary, %d single, %d secondary dropped "
                      "(%.1fs)", st.primary, st.single, st.secondary,
-                     time.time() - t0)
+                     time.monotonic() - t0)
 
     # -- external-mapping re-entry (read-sam/read-bam) --------------------
     if "read-sam" in tasks or "read-bam" in tasks:
@@ -195,17 +199,30 @@ def run_tasks(
             max_ref_seqs=int(cfg.get("chunk-size")),
             haplo_coverage=haplo_coverage,
         )
-        t0 = time.time()
-        results = list(sam2cns(src, longs, s2c))
-        log.info("%s: %d reads corrected (%.1fs)", task, len(results),
-                 time.time() - t0)
-        chim = [(r.record.id, f, t, s)
-                for r in results for (f, t, s) in r.chimera]
-        result = PipelineResult(
-            untrimmed=[r.record for r in results],
-            trimmed=trim_records(results, _trim_params(cfg)),
-            ignored=ignored0, chimera=chim, reports=reports)
-        _apply_siamaera(cfg, result)
+        # metrics parity with Pipeline.run: the re-entry path must also
+        # pre-declare the KPI catalog and populate result.metrics — the
+        # schema contract ("zero-valued counters still appear") holds for
+        # every mode, not just the iterated one
+        from proovread_tpu.pipeline.driver import _declare_metrics
+        with obs.metrics.scope() as reg:
+            _declare_metrics(reg)
+            t0 = time.monotonic()
+            with obs.span(task, cat="task"):
+                results = list(sam2cns(src, longs, s2c))
+            log.info("%s: %d reads corrected (%.1fs)", task, len(results),
+                     time.monotonic() - t0)
+            obs.metrics.counter("reads_processed", unit="reads").inc(
+                len(results))
+            obs.metrics.counter("bases_processed", unit="bases").inc(
+                sum(len(r.record) for r in results))
+            chim = [(r.record.id, f, t, s)
+                    for r in results for (f, t, s) in r.chimera]
+            result = PipelineResult(
+                untrimmed=[r.record for r in results],
+                trimmed=trim_records(results, _trim_params(cfg)),
+                ignored=ignored0, chimera=chim, reports=reports)
+            _apply_siamaera(cfg, result)
+            result.metrics = reg.as_dict()
         return result
 
     # -- utg pass ---------------------------------------------------------
@@ -214,11 +231,12 @@ def run_tasks(
         if not utgs:
             raise ValueError(f"mode {mode!r} needs -u/--unitigs input")
         from proovread_tpu.pipeline.utg import utg_correct
-        t0 = time.time()
-        longs, utg_rep = utg_correct(cfg, longs, utgs)
+        t0 = time.monotonic()
+        with obs.span("utg", cat="task"):
+            longs, utg_rep = utg_correct(cfg, longs, utgs)
         reports.append(utg_rep)
         log.info("utg: masked %.1f%% (%.1fs)", utg_rep.masked_frac * 100,
-                 time.time() - t0)
+                 time.monotonic() - t0)
         utg_corrected = True
 
     # -- legacy mode: the 2014 SHRiMP2 schedule on the jax mapper --------
@@ -268,14 +286,22 @@ def run_tasks(
         # utg-only mode: corrected reads come straight from the utg pass;
         # trimmed output gets the same quality-window + min-length trim as
         # every other mode (bin/proovread:923-933)
+        from proovread_tpu.pipeline.driver import _declare_metrics
         from proovread_tpu.pipeline.trim import trim_window
-        trim = _trim_params(cfg)
-        trimmed = [t for r in longs
-                   if (t := trim_window(r, trim)) is not None]
-        result = PipelineResult(
-            untrimmed=longs, trimmed=trimmed,
-            ignored=ignored0, chimera=[], reports=reports)
-        _apply_siamaera(cfg, result)
+        with obs.metrics.scope() as reg:
+            _declare_metrics(reg)
+            trim = _trim_params(cfg)
+            trimmed = [t for r in longs
+                       if (t := trim_window(r, trim)) is not None]
+            obs.metrics.counter("reads_processed", unit="reads").inc(
+                len(longs))
+            obs.metrics.counter("bases_processed", unit="bases").inc(
+                sum(len(r) for r in longs))
+            result = PipelineResult(
+                untrimmed=longs, trimmed=trimmed,
+                ignored=ignored0, chimera=[], reports=reports)
+            _apply_siamaera(cfg, result)
+            result.metrics = reg.as_dict()
         return result
 
     raise ValueError(f"mode {mode!r}: no runnable tasks in {tasks}")

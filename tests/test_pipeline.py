@@ -524,6 +524,84 @@ class TestSaturationKPI:
         assert int(neligs[0]) >= int(nadms[0])
 
 
+class TestTaskReportStream:
+    """The TaskReport stream is the pipeline's public progress contract
+    (and, since the obs layer, the source of the typed task_runs/KPI
+    counters): task names must follow the mode's vocabulary, iteration
+    counters must be ordered with the finish pass last, and the masked
+    fraction must be non-decreasing across the pre-finish passes (the
+    reference's convergence KPI, bin/proovread:2026-2047)."""
+
+    TOL = 0.05          # sampling rotation may wiggle the mask slightly
+
+    def _check_stream(self, reports, prefix, finish):
+        tasks = [r.task for r in reports]
+        assert tasks, "no task reports"
+        assert tasks[-1] == finish
+        iters = [int(t.rsplit("-", 1)[1]) for t in tasks[:-1]]
+        assert iters == sorted(iters), tasks
+        assert all(t.startswith(prefix) for t in tasks[:-1]), tasks
+        assert iters[0] == 1, "iteration counter must start at 1"
+        fracs = [r.masked_frac for r in reports[:-1]]
+        for a, b in zip(fracs, fracs[1:]):
+            assert b >= a - self.TOL, (tasks, fracs)
+        # the finish report carries supported-fraction, also a fraction
+        assert 0.0 <= reports[-1].masked_frac <= 1.0
+
+    def test_sr_mode_stream(self):
+        rng = np.random.default_rng(71)
+        genome, longs, srs = _make_dataset(rng, G=2500, n_long=2,
+                                           lr_err=0.08, n_sr=350)
+        res = Pipeline(PipelineConfig(
+            mode="sr", n_iterations=3, sampling=False, engine="scan",
+            trim=TrimParams(min_length=300))).run(longs, srs)
+        self._check_stream(res.reports, "bwa-sr-", "bwa-sr-finish")
+
+    def test_mr_mode_stream(self):
+        rng = np.random.default_rng(73)
+        genome, longs, srs = _make_dataset(rng, G=2500, n_long=2,
+                                           lr_err=0.08, n_sr=350)
+        res = Pipeline(PipelineConfig(
+            mode="mr", n_iterations=2, sampling=False, engine="scan",
+            trim=TrimParams(min_length=300))).run(longs, srs)
+        self._check_stream(res.reports, "bwa-mr-", "bwa-mr-finish")
+
+    def test_legacy_shrimp_stream(self):
+        """Legacy mode reports in the SHRiMP task vocabulary with the
+        same ordering/monotonicity contract (proovread.cfg:140)."""
+        from proovread_tpu.config import Config
+        from proovread_tpu.pipeline.tasks import run_tasks
+
+        rng = np.random.default_rng(79)
+        genome, longs, srs = _make_dataset(rng, G=2500, n_long=2,
+                                           lr_err=0.08, n_sr=350)
+        cfg = Config({"batch-reads": 4, "device-chunk": 256,
+                      "seq-filter": {"--min-length": 300}})
+        res = run_tasks(cfg, "legacy", cfg.tasks("legacy"), longs, srs)
+        self._check_stream(res.reports, "shrimp-pre-", "shrimp-finish")
+
+    @pytest.mark.slow
+    def test_device_stream_matches_scan_names(self):
+        """Both engines must emit the same task-name stream for the same
+        schedule (the fused passes report under their per-iteration
+        names, never a 'fused' pseudo-task). Nightly tier: the interpret-
+        mode device engine makes this the costliest stream test."""
+        rng = np.random.default_rng(83)
+        genome, longs, srs = _make_dataset(rng, G=2500, n_long=2,
+                                           lr_err=0.08, n_sr=350)
+
+        def run(engine):
+            return Pipeline(PipelineConfig(
+                mode="sr", n_iterations=2, sampling=False, engine=engine,
+                device_chunk=256, batch_reads=4,
+                trim=TrimParams(min_length=300))).run(longs, srs)
+
+        res_dev = run("device")
+        tasks_scan = [r.task for r in run("scan").reports]
+        assert [r.task for r in res_dev.reports] == tasks_scan
+        self._check_stream(res_dev.reports, "bwa-sr-", "bwa-sr-finish")
+
+
 class TestNaturalOrder:
     def test_natural_key(self):
         from proovread_tpu.pipeline.driver import natural_key

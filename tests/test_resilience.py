@@ -384,6 +384,69 @@ class TestCheckpointResume:
         assert any(r.task.startswith("resume-b") for r in res2.reports)
         assert _fastq_bytes(res2.untrimmed) == _fastq_bytes(res1.untrimmed)
 
+    def test_demotion_reports_carry_producing_rung(self):
+        """Satellite (obs PR): the LAST demotion report of a degraded
+        bucket must name the rung that actually produced its output, and
+        the typed resilience_demotions counter must record the same walk
+        per destination rung (one schema for logs, reports and metrics)."""
+        from proovread_tpu.obs import metrics as obsm
+
+        rng = np.random.default_rng(59)
+        longs, srs = _uniform_dataset(rng, n_long=8)
+        with obsm.scope() as reg:
+            res = Pipeline(_cfg(
+                n_iterations=1,
+                fault_spec="compile@b0")).run(longs, srs)
+        demos = [r for r in res.reports if r.task == "demote-b0"]
+        # device_chunk=128 clamps chunk-halved back to the block floor:
+        # walk is fused -> eager -> host-scan
+        assert [d.note.split("'")[3] for d in demos] == \
+            ["eager", "host-scan"]
+        assert "host-scan" in demos[-1].note, \
+            "last demotion must name the rung that produced the output"
+        assert len(res.untrimmed) == 8
+        # the same walk as typed counters, labeled by destination rung
+        c = reg.counter("resilience_demotions")
+        assert c.value(to_rung="eager") == 1
+        assert c.value(to_rung="host-scan") == 1
+        assert reg.counter("device_faults").value(kind="compile") == 2
+        # and the run's embedded snapshot agrees
+        snap = {tuple(sorted(s["labels"].items())): s["value"]
+                for s in res.metrics["counters"][
+                    "resilience_demotions"]["series"]}
+        assert snap == {(("to_rung", "eager"),): 1,
+                        (("to_rung", "host-scan"),): 1}
+
+    def test_demotion_rewinds_kpi_counters(self):
+        """A failed attempt's partial pass counters must rewind with its
+        TaskReports (driver rewinds reports + sampler; the registry
+        snapshot/restore keeps the metrics in lock-step) — otherwise a
+        retried bucket double-counts candidates/admissions and the dump
+        disagrees with the report stream."""
+        from proovread_tpu.obs import metrics as obsm
+
+        rng = np.random.default_rng(62)
+        longs, srs = _uniform_dataset(rng, n_long=8)
+        with obsm.scope() as reg:
+            res = Pipeline(_cfg(n_iterations=2,
+                                fault_spec="oom@b0.p2")).run(longs, srs)
+        # the fused and eager rungs each complete pass 1 before faulting
+        # at pass 2; only the host-scan attempt's passes may remain
+        per_task = {}
+        for r in res.reports:
+            if not r.note:
+                per_task[r.task] = per_task.get(r.task, 0) + 1
+        c = reg.counter("task_runs")
+        for task, n in per_task.items():
+            assert c.value(task=task) == n, (task, n, c.series)
+        assert reg.counter("candidates_total").value() == \
+            sum(r.n_candidates for r in res.reports if not r.note)
+        assert reg.counter("admitted_total").value() == \
+            sum(r.n_admitted for r in res.reports if not r.note)
+        # the demotions themselves survive the rewind (counted after it)
+        assert reg.counter("resilience_demotions").value(
+            to_rung="eager") == 1
+
     def test_timeout_fault_demotes(self):
         """An injected timeout walks the ladder like any device fault.
         At device_chunk=128 the chunk-halved rung clamps back to the
