@@ -1,6 +1,15 @@
 # Convenience targets; the package itself needs no build step.
+#
+#   make smoke        logic + parity tests (< 2 min edit loop)
+#   make test         adds interpret-mode kernel/device suites
+#   make test-all     everything incl. @slow nightly parity runs
+#   make test-faults  fault-injection resilience suite
+#   make trace-smoke  end-to-end --trace/--metrics-out + schema validation
+#   make perf-check   perf-regression gate over the BENCH_*.json history
+#   make perf-report  PERF.md-style phase/kernel tables from that history
+#   make bench        the benchmark itself (one JSON row on stdout)
 
-.PHONY: smoke test test-all test-faults trace-smoke bench
+.PHONY: smoke test test-all test-faults trace-smoke perf-check perf-report bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -24,11 +33,23 @@ test-faults:
 
 # observability tier: a full CLI run with --trace/--metrics-out, then
 # schema-validation of both artifacts (root span >=95% covered, bucket
-# spans carry the compile/execute split, KPI counter catalog present) —
-# docs/OBSERVABILITY.md. Uses the F.antasticus sample when present, else
-# a synthetic workload; runs on CPU.
+# spans carry the compile/execute split AND the PR-4 cost/memory
+# attribution — flops, bytes accessed, peak bytes, live bytes — plus the
+# end-of-run live-array leak check) — docs/OBSERVABILITY.md. Uses the
+# F.antasticus sample when present, else a synthetic workload; runs on CPU.
 trace-smoke:
 	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.smoke
+
+# perf-regression gate (docs/OBSERVABILITY.md): newest usable BENCH row vs
+# a rolling baseline — headline bases/sec, wall, and per-phase deltas.
+# Exits 1 and prints PERF-REGRESSION lines on any breached threshold.
+perf-check:
+	python -m proovread_tpu.obs.regress check
+
+# PERF.md-style trajectory / phase / kernel-attribution tables, generated
+# from the same history instead of hand-assembled op traces
+perf-report:
+	python -m proovread_tpu.obs.regress report
 
 bench:
 	python bench.py

@@ -36,6 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from proovread_tpu.align.params import AlignParams
+from proovread_tpu.obs import profile as obs_profile
 from proovread_tpu.ops.encode import GAP
 
 NEG = np.float32(-1e9)
@@ -265,6 +266,7 @@ def band_lanes(params: AlignParams) -> int:
     return max(32, ((w + 31) // 32) * 32)
 
 
+@obs_profile.attributed("bsw_expand")
 @functools.partial(jax.jit, static_argnames=("params", "interpret"))
 def bsw_expand(q, win, qlen, params: AlignParams,
                interpret: bool = False) -> BswResult:

@@ -12,9 +12,11 @@ per-task wall-times on stderr.
 
 Observability (docs/OBSERVABILITY.md): ``--trace FILE`` writes the span
 tree as Chrome trace-event JSONL (loadable in Perfetto) and logs an
-end-of-run summary table; ``--metrics-out FILE`` dumps the typed KPI
-counters as one JSON object; ``--log-json`` emits one structured JSON log
-record per line for scrapers.
+end-of-run summary table, a per-kernel cost/memory roofline, and a
+live-array leak report; ``--metrics-out FILE`` dumps the typed KPI
+counters as one JSON object; ``--xprof DIR`` additionally wraps the run in
+``jax.profiler.trace`` with span-named TraceAnnotations; ``--log-json``
+emits one structured JSON log record per line for scrapers.
 """
 
 from __future__ import annotations
@@ -95,6 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--metrics-out", metavar="FILE",
                     help="dump the typed KPI counters/gauges/histograms "
                          "as one JSON object (docs/OBSERVABILITY.md)")
+    ap.add_argument("--xprof", metavar="DIR",
+                    help="wrap the run in jax.profiler.trace(DIR) with "
+                         "TraceAnnotations named after the spans, so XLA "
+                         "op traces (xprof/TensorBoard) line up with the "
+                         "span tree; implies span tracing "
+                         "(docs/OBSERVABILITY.md)")
     ap.add_argument("--log-json", action="store_true",
                     help="one structured JSON log record per line "
                          "(ts/level/logger/msg) instead of the human "
@@ -234,11 +242,44 @@ def main(argv: Optional[List[str]] = None) -> int:
     name = os.path.basename(outdir.rstrip("/")) or "proovread"
 
     # observability (docs/OBSERVABILITY.md): flags override config keys so
-    # a user cfg can turn tracing on for every run of a deployment
+    # a user cfg can turn tracing on for every run of a deployment.
+    # Tracing brings the whole attribution stack with it — profiler (per-
+    # kernel cost/memory) and memory sampler (span-boundary telemetry +
+    # leak report) — because a traced run is already paying the fencing
+    # serialization; timed runs stay untraced AND unprofiled.
     trace_path = args.trace or cfg.get("trace-file")
     metrics_path = args.metrics_out or cfg.get("metrics-out")
-    tracer = obs.install_tracer() if trace_path else None
+    tracing_on = bool(trace_path or args.xprof)
+    tracer = obs.install_tracer() if tracing_on else None
     registry = obs.metrics.install() if metrics_path else None
+    profiler = obs.profile.install() if tracing_on else None
+    mem_sampler = obs.memory.install() if tracing_on else None
+    leak_check = obs.memory.LeakCheck() if tracing_on else None
+    xprof_cm = None
+    if args.xprof:
+        # a failed profiler-session start (unwritable dir, session already
+        # active) must unwind every global install above — a host app
+        # calling main() repeatedly would otherwise stay traced/fenced
+        # for the rest of the process
+        try:
+            from proovread_tpu.obs import trace as obs_trace
+            import jax.profiler
+            obs_trace.set_annotations(True)
+            xprof_cm = jax.profiler.trace(args.xprof)
+            xprof_cm.__enter__()
+        except Exception:
+            obs_trace.set_annotations(False)
+            if mem_sampler is not None:
+                obs.memory.uninstall()
+            if profiler is not None:
+                obs.profile.uninstall()
+            if tracer is not None:
+                obs.uninstall_tracer()
+            if registry is not None:
+                obs.metrics.uninstall()
+            raise
+        log.info("xprof: XLA op trace -> %s (TraceAnnotations follow the "
+                 "span tree)", args.xprof)
 
     t_start = time.monotonic()
     try:
@@ -247,17 +288,40 @@ def main(argv: Optional[List[str]] = None) -> int:
         # write the artifacts even on a crashed run — the partial span
         # tree (which bucket/pass was live) and the fault counters are
         # exactly the data a crash diagnosis needs
+        if xprof_cm is not None:
+            from proovread_tpu.obs import trace as obs_trace
+            obs_trace.set_annotations(False)
+            try:
+                xprof_cm.__exit__(None, None, None)
+            except Exception as e:                      # noqa: BLE001
+                log.warning("xprof trace close failed: %s", e)
+        if mem_sampler is not None:
+            obs.memory.uninstall()
         if tracer is not None:
             obs.uninstall_tracer()
             try:
-                tracer.write_chrome(trace_path)
-                log.info("trace: %d span(s) -> %s (load in "
-                         "ui.perfetto.dev)", len(tracer.events),
-                         trace_path)
+                if trace_path:
+                    tracer.write_chrome(trace_path)
+                    log.info("trace: %d span(s) -> %s (load in "
+                             "ui.perfetto.dev)", len(tracer.events),
+                             trace_path)
                 for ln in tracer.summary_lines():
                     log.info("%s", ln)
             except OSError as e:
                 log.warning("trace write failed: %s", e)
+        if profiler is not None:
+            obs.profile.uninstall()
+            if profiler.records:
+                for ln in obs.profile.roofline_lines(profiler):
+                    log.info("%s", ln)
+            if leak_check is not None:
+                # deferred to interpreter exit: the honest reading needs
+                # jax.clear_caches(), which would force a host application
+                # calling main() repeatedly in-process to recompile every
+                # program on its NEXT run. At exit the clear is free, and
+                # the one-shot CLI (the normal case) exits immediately
+                # after this anyway.
+                _queue_leak_report(leak_check)
         if registry is not None:
             obs.metrics.uninstall()
             try:
@@ -277,6 +341,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         return rc
     log.info("total wall: %.1fs", time.monotonic() - t_start)
     return 0
+
+
+_pending_leak_check = None
+_leak_atexit_registered = False
+
+
+def _queue_leak_report(leak_check) -> None:
+    """Queue exactly ONE end-of-process leak report, keyed to the most
+    recent traced run. Repeated in-process main() calls replace the
+    pending check instead of stacking handlers — an earlier run's
+    baseline would misattribute every later run's (and the host app's)
+    arrays as its own leaks."""
+    global _pending_leak_check, _leak_atexit_registered
+    _pending_leak_check = leak_check
+    if not _leak_atexit_registered:
+        _leak_atexit_registered = True
+        import atexit
+        atexit.register(_report_pending_leaks)
+
+
+def _report_pending_leaks() -> None:
+    """End-of-process live-array leak report for the last traced run
+    (deferred so the cache-clearing measurement never taxes a host
+    application's subsequent in-process runs)."""
+    leak_check = _pending_leak_check
+    if leak_check is None:
+        return
+    try:
+        rep = leak_check.report()
+        lvl = (log.warning if rep["leaked_bytes"] > (1 << 20)
+               else log.info)
+        lvl("leak check: %d array(s) / %d bytes still live after the "
+            "run%s", rep["n_leaked"], rep["leaked_bytes"],
+            f" — top: {rep['examples']}" if rep["n_leaked"] else "")
+    except Exception as e:                              # noqa: BLE001
+        log.warning("leak check failed: %s", e)
 
 
 def _run(args, argv, cfg, outdir: str, name: str, ckpt_dir: Optional[str],

@@ -5,6 +5,11 @@
   (``obs.trace``); serialized as Chrome trace-event JSONL (Perfetto).
 - ``obs.metrics`` — typed counter/gauge/histogram registry dumped as one
   JSON object and embedded in ``PipelineResult.metrics``.
+- ``obs.profile`` — lazy per-kernel cost/memory attribution
+  (``Compiled.cost_analysis()``/``memory_analysis()`` per entry point,
+  roofline vs. per-backend peaks) attached to spans and metrics.
+- ``obs.memory`` — device-memory telemetry sampled at span boundaries
+  plus an end-of-run live-array leak check.
 
 Both are off by default (shared no-op singletons) and are enabled by the
 CLI ``--trace`` / ``--metrics-out`` flags, the ``trace-file`` /
@@ -12,7 +17,8 @@ CLI ``--trace`` / ``--metrics-out`` flags, the ``trace-file`` /
 ``obs.tracing()`` / ``obs.metrics.scope()``. See docs/OBSERVABILITY.md.
 """
 
-from proovread_tpu.obs import metrics
+from proovread_tpu.obs import memory, metrics, profile
+from proovread_tpu.obs.profile import profiling
 from proovread_tpu.obs.trace import (NOOP_SPAN, Span, Tracer, count_retrace,
                                      enabled, span, tracing)
 from proovread_tpu.obs.trace import current as current_tracer
@@ -20,7 +26,7 @@ from proovread_tpu.obs.trace import install as install_tracer
 from proovread_tpu.obs.trace import uninstall as uninstall_tracer
 
 __all__ = [
-    "metrics", "span", "Span", "Tracer", "tracing", "enabled",
-    "count_retrace", "current_tracer", "install_tracer", "uninstall_tracer",
-    "NOOP_SPAN",
+    "metrics", "memory", "profile", "profiling", "span", "Span", "Tracer",
+    "tracing", "enabled", "count_retrace", "current_tracer",
+    "install_tracer", "uninstall_tracer", "NOOP_SPAN",
 ]

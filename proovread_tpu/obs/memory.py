@@ -1,0 +1,185 @@
+"""Device-memory telemetry: span-boundary sampling + live-array leak check.
+
+Two facilities, both opt-in and CPU-safe:
+
+- :class:`MemorySampler` — installed alongside the tracer (``--trace``,
+  bench attribution runs). At every bucket/attempt/pass/task span exit it
+  samples ``device.memory_stats()`` (TPU/GPU ``bytes_in_use`` /
+  ``peak_bytes_in_use``; None on CPU) and falls back to walking
+  ``jax.live_arrays()`` (the sum of live jax-array nbytes — host-side
+  truth that exists on every backend). The sample lands in the span args
+  (``live_bytes``, ``device_bytes_in_use``), rolls up into the enclosing
+  bucket's ``peak_live_bytes``, and feeds the ``peak_live_bytes`` /
+  ``bucket_peak_live_bytes`` gauges.
+
+- :class:`LeakCheck` — snapshot the live-array population before a run,
+  report what is still live after it. ``obs/smoke.py`` wires this around
+  the end-to-end CLI run: a pipeline that parks device arrays in module
+  state grows its HBM floor with every invocation, which is invisible to
+  wall-clock benches until it OOMs at scale. ``jax.clear_caches()`` runs
+  first (jit executables pin their constants — cache residency is policy,
+  not a leak).
+
+Nothing here runs while no sampler is installed: the hook in
+``Span.__exit__`` is one module-global read (the zero-overhead guard in
+``tests/test_profile.py`` enforces this).
+"""
+
+from __future__ import annotations
+
+import gc
+import logging
+import weakref
+from typing import Any, Dict, List, Optional
+
+from proovread_tpu.obs import metrics as obs_metrics
+from proovread_tpu.obs import trace as obs_trace
+
+log = logging.getLogger("proovread_tpu")
+
+
+def live_bytes() -> int:
+    """Total nbytes of all live jax arrays (every backend)."""
+    import jax
+    return sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """``device.memory_stats()`` when the backend provides it (TPU/GPU),
+    else None (CPU). Keys of interest: ``bytes_in_use``,
+    ``peak_bytes_in_use``, ``bytes_limit``."""
+    try:
+        import jax
+        d = device if device is not None else jax.local_devices()[0]
+        return d.memory_stats()
+    except Exception:                                   # noqa: BLE001
+        return None
+
+
+class MemorySampler:
+    """Span-boundary memory telemetry (installed via :func:`install`)."""
+
+    def __init__(self):
+        self.n_samples = 0
+        self.peak_live = 0
+        self.peak_device = 0
+
+    def sample(self, span, tracer) -> None:
+        """Called from ``Span.__exit__`` for coarse span categories."""
+        lb = live_bytes()
+        self.n_samples += 1
+        self.peak_live = max(self.peak_live, lb)
+        span.args["live_bytes"] = lb
+        span.mem_peak = max(span.mem_peak, lb)
+        ms = device_memory_stats()
+        if ms:
+            in_use = int(ms.get("bytes_in_use", 0))
+            span.args["device_bytes_in_use"] = in_use
+            self.peak_device = max(
+                self.peak_device, int(ms.get("peak_bytes_in_use", in_use)))
+        # roll the sample up into every open ancestor: the bucket span's
+        # peak must cover its children's high-water marks
+        for sp in tracer._stack:
+            sp.mem_peak = max(sp.mem_peak, lb)
+        reg = obs_metrics.current()
+        if reg is not None:
+            g = reg.gauge("peak_live_bytes", unit="bytes",
+                          help="max sampled live jax-array bytes")
+            g.set(max(g.value(), lb))
+            if span.cat == "bucket" and "bucket" in span.args:
+                gb = reg.gauge("bucket_peak_live_bytes", unit="bytes",
+                               help="per-bucket peak sampled live bytes")
+                b = span.args["bucket"]
+                gb.set(max(gb.value(bucket=b), span.mem_peak), bucket=b)
+
+
+_current: Optional[MemorySampler] = None
+
+
+def current() -> Optional[MemorySampler]:
+    return _current
+
+
+def install(sampler: Optional[MemorySampler] = None) -> MemorySampler:
+    global _current
+    _current = sampler if sampler is not None else MemorySampler()
+    obs_trace.set_memory_sampler(_current)
+    return _current
+
+
+def uninstall() -> None:
+    global _current
+    _current = None
+    obs_trace.set_memory_sampler(None)
+
+
+# -- leak check -----------------------------------------------------------
+
+_ABSENT = object()      # sentinel: id not seen at baseline at all
+
+
+class LeakCheck:
+    """Live-array population diff around a run.
+
+    >>> lc = LeakCheck()          # snapshot baseline
+    >>> run()
+    >>> rep = lc.report()         # what's still live that wasn't before
+    >>> assert rep["leaked_bytes"] <= tolerance
+    """
+
+    def __init__(self):
+        import jax
+        # id -> weakref of the baseline array: a bare id set would let a
+        # freed baseline array's recycled address mask a genuinely leaked
+        # new array (CPython reuses object addresses aggressively). The
+        # weakref proves the id still names the SAME object — without
+        # pinning the baseline arrays alive the way strong refs would.
+        self._base: Dict[int, Optional[weakref.ref]] = {}
+        for a in jax.live_arrays():
+            try:
+                self._base[id(a)] = weakref.ref(a)
+            except TypeError:       # non-weakrefable array type: id-trust
+                self._base[id(a)] = None
+
+    def report(self, clear_caches: bool = True,
+               top: int = 5) -> Dict[str, Any]:
+        """Collect + (optionally) drop jit caches, then diff live arrays
+        against the baseline. jit executables legitimately pin constants,
+        so ``clear_caches=True`` is the honest end-of-run reading; pass
+        False to measure cache residency itself."""
+        import jax
+        if clear_caches:
+            jax.clear_caches()
+        gc.collect()
+
+        def _is_new(a) -> bool:
+            ref = self._base.get(id(a), _ABSENT)
+            if ref is _ABSENT:
+                return True
+            # id present but the baseline object died and the address was
+            # recycled by a new array: that IS a leak
+            return ref is not None and ref() is not a
+
+        leaked = [a for a in jax.live_arrays() if _is_new(a)]
+        leaked_bytes = sum(int(getattr(a, "nbytes", 0)) for a in leaked)
+        examples: List[str] = []
+        for a in sorted(leaked, key=lambda x: -int(getattr(x, "nbytes", 0))
+                        )[:top]:
+            try:
+                examples.append(f"{a.dtype}{list(a.shape)}"
+                                f"={int(a.nbytes)}B")
+            except Exception:                           # noqa: BLE001
+                examples.append(repr(type(a)))
+        return {"n_leaked": len(leaked), "leaked_bytes": leaked_bytes,
+                "examples": examples}
+
+    def assert_clean(self, tolerate_bytes: int = 1 << 20,
+                     clear_caches: bool = True) -> Dict[str, Any]:
+        """Raise AssertionError when more than ``tolerate_bytes`` of new
+        arrays survived the run; returns the report otherwise."""
+        rep = self.report(clear_caches=clear_caches)
+        assert rep["leaked_bytes"] <= tolerate_bytes, (
+            f"live-array leak: {rep['n_leaked']} array(s), "
+            f"{rep['leaked_bytes']} bytes still live after the run "
+            f"(> {tolerate_bytes} tolerated): {rep['examples']}")
+        return rep

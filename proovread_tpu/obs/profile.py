@@ -1,0 +1,354 @@
+"""Per-kernel cost/memory attribution (the PR-3 span tree, grown teeth).
+
+``PERF.md``'s op tables were assembled by hand from one-off XLA traces;
+this module automates that attribution. Every jitted/Pallas entry point in
+the hot path is wrapped with :func:`attributed`, and while a
+:class:`Profiler` is installed (``--trace``, bench attribution runs,
+``obs.profiling()``) each call:
+
+- resolves the program's **static cost model** — ``Compiled.
+  cost_analysis()`` (flops, bytes accessed) and ``memory_analysis()``
+  (argument / output / temp bytes, summed to a peak estimate) — cached
+  per (entry, abstract-signature), so re-lowering happens once per shape,
+  not per call;
+- accumulates it into a per-entry record together with the **measured**
+  execute time (the wrapper blocks on the outputs — same perturbation
+  contract as span fencing, which is why timed bench runs stay
+  unprofiled);
+- attributes flops/bytes/peak to every open span (``Tracer._on_cost``),
+  so bucket/pass spans carry their cost totals in the trace args; and
+- mirrors the totals into the metrics registry (``kernel_flops_total``,
+  ``kernel_bytes_total``, ``kernel_peak_bytes``).
+
+**Zero overhead off**: the wrapper costs one module-global read per call;
+no cost-analysis, lowering, or blocking happens until a profiler is
+installed (guarded by ``tests/test_profile.py::test_zero_overhead``).
+
+**Roofline** (:func:`roofline_lines`): achieved FLOP/s and B/s per entry
+against the per-backend peaks in :data:`DEVICE_PEAKS`. Unknown backends
+(CPU) fall back to counts-only — the flop/byte arithmetic intensity is
+still printed, the %-of-peak columns are not.
+
+The profiler's own ``lower().compile()`` calls fire
+``backend_compile_duration`` events; they run under
+``trace.suspended_compile_attribution()`` so a profiled run's span
+compile_ms still means *pipeline* compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+from proovread_tpu.obs import metrics as obs_metrics
+from proovread_tpu.obs import trace as obs_trace
+
+log = logging.getLogger("proovread_tpu")
+
+# Per-backend peak (FLOP/s, HBM bytes/s), matched by substring against
+# ``jax.devices()[0].device_kind.lower()``. bf16 peaks — the pipeline's
+# arithmetic is int8/bf16/f32 mixed, so these bound, not predict.
+DEVICE_PEAKS: Dict[str, Tuple[float, float]] = {
+    "tpu v2": (45e12, 700e9),
+    "tpu v3": (123e12, 900e9),
+    "tpu v4": (275e12, 1228e9),
+    "tpu v5 lite": (197e12, 819e9),     # v5e's device_kind spelling
+    "tpu v5e": (197e12, 819e9),
+    "tpu v5p": (459e12, 2765e9),
+    "tpu v6": (918e12, 1640e9),         # trillium
+}
+
+
+def device_peaks(device_kind: Optional[str] = None
+                 ) -> Optional[Tuple[float, float]]:
+    """(peak FLOP/s, peak B/s) for the active backend, or None when the
+    device is not in the spec table (CPU: counts-only fallback)."""
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:                               # noqa: BLE001
+            return None
+    dk = device_kind.lower()
+    for key, peaks in DEVICE_PEAKS.items():
+        if key in dk:
+            return peaks
+    return None
+
+
+class KernelRecord:
+    """Cumulative attribution for one profiled entry point."""
+
+    __slots__ = ("name", "calls", "flops", "bytes_accessed", "peak_bytes",
+                 "exec_s", "compile_s", "n_signatures", "cost_errors")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.peak_bytes = 0.0       # max over signatures
+        self.exec_s = 0.0           # measured (blocking) wall, minus
+        #                             backend compiles inside the window
+        self.compile_s = 0.0        # backend-compile seconds in-window
+        #                             (first call per signature/shape)
+        self.n_signatures = 0
+        self.cost_errors = 0        # signatures whose analysis failed
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"calls": self.calls, "flops": self.flops,
+                "bytes_accessed": self.bytes_accessed,
+                "peak_bytes": self.peak_bytes,
+                "exec_s": round(self.exec_s, 4),
+                "compile_s": round(self.compile_s, 4),
+                "n_signatures": self.n_signatures,
+                "cost_errors": self.cost_errors}
+
+
+def _spec_of(x):
+    """Array leaf -> ShapeDtypeStruct (lowering needs only the aval — and
+    donated arguments are already consumed by the time we lower)."""
+    import jax
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x
+
+
+class Profiler:
+    """Cost/memory attribution collector for one run."""
+
+    def __init__(self):
+        self.records: Dict[str, KernelRecord] = {}
+        self._sig_cost: Dict[Tuple[str, str], Optional[Dict[str, float]]] \
+            = {}
+        # backend-compile seconds observed process-wide while this
+        # profiler is installed (fed by trace.py's monitoring listener);
+        # per-call deltas split each call window into compile vs execute
+        self._compile_s_seen = 0.0
+
+    def _on_backend_compile(self, duration: float) -> None:
+        self._compile_s_seen += duration
+
+    # -- capture ----------------------------------------------------------
+    def call(self, name: str, jfn, args: tuple, kwargs: dict):
+        """Run ``jfn`` with attribution. Called by the :func:`attributed`
+        wrapper only while a profiler is installed."""
+        import jax
+
+        # inside another jit trace the args are Tracers: the call inlines
+        # into the outer program, which is the one that gets attributed
+        if any(isinstance(leaf, jax.core.Tracer)
+               for leaf in jax.tree_util.tree_leaves((args, kwargs))):
+            return jfn(*args, **kwargs)
+
+        # specs BEFORE the call: donated buffers are dead afterwards
+        spec_args, spec_kwargs = jax.tree_util.tree_map(
+            _spec_of, (args, kwargs))
+        c0 = self._compile_s_seen
+        t0 = time.monotonic()
+        out = jfn(*args, **kwargs)
+        try:
+            jax.block_until_ready(out)
+        except Exception:                               # noqa: BLE001
+            pass
+        dt = time.monotonic() - t0
+        # the first call at a fresh signature jit-compiles INSIDE this
+        # window; split it out so achieved FLOP/s means execution, not
+        # compilation (the span layer's compile_ms/execute_ms contract)
+        dc = min(self._compile_s_seen - c0, dt)
+
+        cost = self._cost(name, jfn, spec_args, spec_kwargs)
+        rec = self.records.get(name)
+        if rec is None:
+            rec = self.records[name] = KernelRecord(name)
+        rec.calls += 1
+        rec.compile_s += dc
+        rec.exec_s += max(dt - dc, 0.0)
+        if cost is not None:
+            rec.flops += cost["flops"]
+            rec.bytes_accessed += cost["bytes_accessed"]
+            rec.peak_bytes = max(rec.peak_bytes, cost["peak_bytes"])
+            tr = obs_trace.current()
+            if tr is not None:
+                tr._on_cost(cost["flops"], cost["bytes_accessed"],
+                            cost["peak_bytes"])
+            reg = obs_metrics.current()
+            if reg is not None:
+                reg.counter("kernel_flops_total", unit="flops",
+                            help="cost_analysis flops per profiled entry "
+                                 "point").inc(cost["flops"], fn=name)
+                reg.counter("kernel_bytes_total", unit="bytes",
+                            help="cost_analysis bytes accessed per "
+                                 "profiled entry point").inc(
+                    cost["bytes_accessed"], fn=name)
+                g = reg.gauge("kernel_peak_bytes", unit="bytes",
+                              help="memory_analysis arg+out+temp peak per "
+                                   "profiled entry point")
+                g.set(max(g.value(fn=name), cost["peak_bytes"]), fn=name)
+        return out
+
+    def _cost(self, name: str, jfn, spec_args, spec_kwargs
+              ) -> Optional[Dict[str, float]]:
+        """Static cost model per (entry, signature); cached. Returns None
+        when the backend can't analyze the program (the record still
+        counts calls/exec_s — counts-only degradation, never a fault)."""
+        key = (name, repr((spec_args, spec_kwargs)))
+        if key in self._sig_cost:
+            return self._sig_cost[key]
+        cost: Optional[Dict[str, float]] = None
+        try:
+            with obs_trace.suspended_compile_attribution():
+                compiled = jfn.lower(*spec_args, **spec_kwargs).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            ca = ca or {}
+            peak = 0.0
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                peak = float(
+                    getattr(ma, "argument_size_in_bytes", 0)
+                    + getattr(ma, "output_size_in_bytes", 0)
+                    + getattr(ma, "temp_size_in_bytes", 0)
+                    + getattr(ma, "generated_code_size_in_bytes", 0))
+            cost = {"flops": float(ca.get("flops", 0.0)),
+                    "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+                    "peak_bytes": peak}
+        except Exception as e:                          # noqa: BLE001
+            rec = self.records.get(name)
+            if rec is None:
+                rec = self.records[name] = KernelRecord(name)
+            rec.cost_errors += 1
+            log.debug("cost analysis failed for %s: %s: %s",
+                      name, type(e).__name__, e)
+        else:
+            rec = self.records.get(name)
+            if rec is None:
+                rec = self.records[name] = KernelRecord(name)
+            rec.n_signatures += 1
+        self._sig_cost[key] = cost
+        return cost
+
+    # -- serialization ----------------------------------------------------
+    def as_dict(self) -> Dict[str, Any]:
+        """Per-entry attribution table (bench row ``"kernels"`` key)."""
+        return {name: rec.as_dict()
+                for name, rec in sorted(self.records.items())}
+
+    def totals(self) -> Dict[str, float]:
+        return {"flops": sum(r.flops for r in self.records.values()),
+                "bytes_accessed": sum(r.bytes_accessed
+                                      for r in self.records.values()),
+                "peak_bytes": max(
+                    [r.peak_bytes for r in self.records.values()],
+                    default=0.0)}
+
+
+def roofline_lines(profiler: Profiler,
+                   device_kind: Optional[str] = None) -> List[str]:
+    """Per-entry roofline table: static counts, measured time, achieved
+    rates — and %-of-peak when the backend is in :data:`DEVICE_PEAKS`.
+    Counts-only on unknown backends (the CPU fallback)."""
+    peaks = device_peaks(device_kind)
+    hdr = (f"{'kernel':<24}{'calls':>6}{'GFLOP':>10}{'GB':>9}"
+           f"{'FLOP/B':>8}{'exec_s':>9}{'comp_s':>8}{'GFLOP/s':>10}"
+           f"{'GB/s':>9}")
+    if peaks:
+        hdr += f"{'%peakF':>8}{'%peakB':>8}"
+    lines = [hdr]
+    for name, rec in sorted(profiler.records.items(),
+                            key=lambda kv: -kv[1].exec_s):
+        gf = rec.flops / 1e9
+        gb = rec.bytes_accessed / 1e9
+        ai = rec.flops / rec.bytes_accessed if rec.bytes_accessed else 0.0
+        fs = rec.flops / rec.exec_s if rec.exec_s else 0.0
+        bs = rec.bytes_accessed / rec.exec_s if rec.exec_s else 0.0
+        ln = (f"{name:<24}{rec.calls:>6}{gf:>10.3f}{gb:>9.3f}"
+              f"{ai:>8.2f}{rec.exec_s:>9.3f}{rec.compile_s:>8.3f}"
+              f"{fs / 1e9:>10.2f}{bs / 1e9:>9.2f}")
+        if peaks:
+            ln += (f"{100 * fs / peaks[0]:>8.2f}"
+                   f"{100 * bs / peaks[1]:>8.2f}")
+        lines.append(ln)
+    if not peaks:
+        lines.append("(device not in DEVICE_PEAKS: counts-only — achieved "
+                     "rates shown, %-of-peak omitted)")
+    return lines
+
+
+# -- installation ---------------------------------------------------------
+
+_current: Optional[Profiler] = None
+
+
+def current() -> Optional[Profiler]:
+    return _current
+
+
+def enabled() -> bool:
+    return _current is not None
+
+
+def install(profiler: Optional[Profiler] = None) -> Profiler:
+    global _current
+    _current = profiler if profiler is not None else Profiler()
+    obs_trace.set_profile_active(True)
+    obs_trace.set_profile_compile_listener(_current._on_backend_compile)
+    obs_trace._install_monitoring_hook()
+    return _current
+
+
+def uninstall() -> None:
+    global _current
+    _current = None
+    obs_trace.set_profile_active(False)
+    obs_trace.set_profile_compile_listener(None)
+
+
+@contextmanager
+def profiling(profiler: Optional[Profiler] = None):
+    """Scoped profiler installation (tests, bench attribution runs)."""
+    global _current
+    prev = _current
+    p = install(profiler)
+    try:
+        yield p
+    finally:
+        _current = prev
+        obs_trace.set_profile_active(prev is not None)
+        obs_trace.set_profile_compile_listener(
+            prev._on_backend_compile if prev is not None else None)
+
+
+def attributed(name: Optional[str] = None):
+    """Wrap a jitted entry point for lazy cost/memory attribution::
+
+        @attributed("fused_accumulate")
+        @functools.partial(jax.jit, ...)
+        def fused_accumulate(...): ...
+
+    Off (no profiler installed) the wrapper costs one module-global read.
+    The underlying jit object stays reachable as ``fn.__wrapped__``.
+    """
+    def deco(jfn):
+        fn_name = name or getattr(jfn, "__name__", "jit_fn")
+
+        @functools.wraps(jfn)
+        def wrapper(*args, **kwargs):
+            prof = _current
+            if prof is None:
+                return jfn(*args, **kwargs)
+            return prof.call(fn_name, jfn, args, kwargs)
+
+        wrapper.__wrapped__ = jfn
+        # forward the jit-object API callers rely on (tests clear the jit
+        # cache via pileup_accumulate_bits.clear_cache(); .lower keeps
+        # working for ahead-of-time users)
+        for attr in ("clear_cache", "lower", "eval_shape", "trace"):
+            if hasattr(jfn, attr):
+                setattr(wrapper, attr, getattr(jfn, attr))
+        return wrapper
+    return deco

@@ -1,0 +1,353 @@
+"""Perf-regression gate over the ``BENCH_*.json`` history.
+
+Every perf PR claims a number; this module makes the claim checkable from
+artifacts. ``bench.py`` emits one JSON row per round (the driver wraps it
+as ``{"n", "rc", "parsed": row}``); since PR 3 the row carries a
+per-phase ``"phases"`` breakdown and now (PR 4) per-kernel
+``"kernels"`` cost attribution. The gate:
+
+- loads the history (wrapper objects or bare bench rows, one per file or
+  JSON-lines),
+- takes the newest **usable** row (parsed, non-timeout, same config) and
+  a rolling baseline of the previous usable rows,
+- computes deltas for the headline bases/sec, the wall time, and each
+  span phase's ``total_s``,
+- emits one ``PERF-REGRESSION:`` line per breached threshold plus a
+  final machine-readable JSON verdict, and exits 1 on any breach.
+
+Degradations are explicit, never silent: unusable rows (``rc != 0``,
+``"timeout": true``, empty ``parsed``) and attribution gaps (a baseline
+with phases vs. a latest row without) appear as non-fatal ``missing``
+items in the verdict — the gate fails on measured regressions, not on
+missing measurements (the bench driver owns "the bench must produce a
+row"; this gate owns "the row must not be slower").
+
+CLI (``make perf-check`` / ``make perf-report``)::
+
+    python -m proovread_tpu.obs.regress check  [BENCH_*.json ...]
+    python -m proovread_tpu.obs.regress report [BENCH_*.json ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+# headline throughput may drop by this fraction vs. the rolling-baseline
+# median before the gate trips (tunneled-device scheduler jitter is ±0.5 s
+# on a ~4 s bench; thresholds below that noise floor would cry wolf)
+VALUE_THRESHOLD = 0.20
+# per-phase wall seconds may grow by this fraction ...
+PHASE_THRESHOLD = 0.30
+# ... but only when the absolute growth also exceeds this (a 10 ms phase
+# doubling is measurement noise, not a regression)
+MIN_ABS_S = 0.5
+# rolling baseline: median over up to this many prior usable rows
+BASELINE_WINDOW = 3
+
+
+def load_rows(paths: List[str]) -> List[Dict[str, Any]]:
+    """Parse bench history files into ``{"source", "n", "rc", "row"}``
+    entries, oldest first. Accepts the driver wrapper shape
+    (``{"n", "rc", "parsed"}``), bare bench rows, and JSON-lines files
+    of either."""
+    out: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as fh:
+            text = fh.read()
+        objs: List[Any] = []
+        try:
+            objs = [json.loads(text)]
+        except json.JSONDecodeError:
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    objs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        for obj in objs:
+            if not isinstance(obj, dict):
+                continue
+            if "parsed" in obj or "rc" in obj:
+                out.append({"source": path, "n": obj.get("n"),
+                            "rc": obj.get("rc", 0),
+                            "row": obj.get("parsed") or None})
+            elif "metric" in obj:
+                out.append({"source": path, "n": None, "rc": 0,
+                            "row": obj})
+    out.sort(key=lambda e: (e["n"] is None, e["n"], e["source"]))
+    return out
+
+
+def _usable(entry: Dict[str, Any]) -> bool:
+    row = entry["row"]
+    return (isinstance(row, dict) and row.get("metric")
+            and row.get("value") is not None
+            and not row.get("timeout"))
+
+
+def _config_of(row: Dict[str, Any]) -> int:
+    return int(row.get("config", 1))
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def perf_check(entries: List[Dict[str, Any]],
+               value_threshold: float = VALUE_THRESHOLD,
+               phase_threshold: float = PHASE_THRESHOLD,
+               min_abs_s: float = MIN_ABS_S,
+               window: int = BASELINE_WINDOW) -> Dict[str, Any]:
+    """The gate, as data. Returns ``{"schema", "verdict", "latest",
+    "baseline_rounds", "checks": [...]}`` with verdict PASS / REGRESSION /
+    NO-DATA; each check item is ``{"check", "status", ...}`` with status
+    ok / regressed / missing / skipped."""
+    checks: List[Dict[str, Any]] = []
+    for e in entries:
+        if not _usable(e):
+            checks.append({
+                "check": "row", "status": "missing",
+                "source": e["source"], "rc": e["rc"],
+                "note": ("timeout row" if isinstance(e["row"], dict)
+                         and e["row"] and e["row"].get("timeout")
+                         else f"no parsable bench row (rc={e['rc']})")})
+    usable = [e for e in entries if _usable(e)]
+    if not usable:
+        return {"schema": SCHEMA_VERSION, "verdict": "NO-DATA",
+                "latest": None, "baseline_rounds": [], "checks": checks}
+
+    latest = usable[-1]
+    cfg = _config_of(latest["row"])
+    pool = [e for e in usable[:-1]
+            if _config_of(e["row"]) == cfg][-window:]
+    if not pool:
+        checks.append({"check": "baseline", "status": "skipped",
+                       "note": f"no prior usable rows at config {cfg} — "
+                               "nothing to regress against"})
+        verdict = "PASS"
+        return {"schema": SCHEMA_VERSION, "verdict": verdict,
+                "latest": latest["source"], "baseline_rounds":
+                [], "checks": checks}
+
+    lrow = latest["row"]
+
+    def _delta_check(name: str, new: float, base: float, *,
+                     higher_is_better: bool, threshold: float,
+                     min_abs: float = 0.0) -> Dict[str, Any]:
+        if base <= 0:
+            return {"check": name, "status": "skipped",
+                    "note": "zero/absent baseline"}
+        delta = (new - base) / base
+        bad = (-delta if higher_is_better else delta)
+        abs_growth = abs(new - base)
+        regressed = bad > threshold and abs_growth >= min_abs
+        return {"check": name, "status":
+                "regressed" if regressed else "ok",
+                "value": round(new, 4), "baseline": round(base, 4),
+                "delta_frac": round(delta, 4),
+                "threshold": threshold}
+
+    # headline throughput (higher is better)
+    checks.append(_delta_check(
+        "value:bases_per_sec", float(lrow["value"]),
+        _median([float(e["row"]["value"]) for e in pool]),
+        higher_is_better=True, threshold=value_threshold))
+
+    # total wall (lower is better)
+    walls = [float(e["row"]["wall_s"]) for e in pool
+             if e["row"].get("wall_s") is not None]
+    if walls and lrow.get("wall_s") is not None:
+        checks.append(_delta_check(
+            "wall_s", float(lrow["wall_s"]), _median(walls),
+            higher_is_better=False, threshold=value_threshold,
+            min_abs=min_abs_s))
+
+    # per-phase wall (lower is better): phases the baseline knows about
+    base_phases: Dict[str, List[float]] = {}
+    for e in pool:
+        for cat, ph in (e["row"].get("phases") or {}).items():
+            if isinstance(ph, dict) and "total_s" in ph:
+                base_phases.setdefault(cat, []).append(
+                    float(ph["total_s"]))
+    lphases = lrow.get("phases") or {}
+    for cat, vals in sorted(base_phases.items()):
+        lp = lphases.get(cat)
+        if not isinstance(lp, dict) or "total_s" not in lp:
+            checks.append({"check": f"phase:{cat}", "status": "missing",
+                           "note": "baseline has this phase, latest row "
+                                   "carries no attribution for it"})
+            continue
+        checks.append(_delta_check(
+            f"phase:{cat}", float(lp["total_s"]), _median(vals),
+            higher_is_better=False, threshold=phase_threshold,
+            min_abs=min_abs_s))
+    for cat in sorted(set(lphases) - set(base_phases)):
+        checks.append({"check": f"phase:{cat}", "status": "skipped",
+                       "note": "no baseline rows carry this phase yet"})
+
+    verdict = ("REGRESSION" if any(c["status"] == "regressed"
+                                   for c in checks) else "PASS")
+    return {"schema": SCHEMA_VERSION, "verdict": verdict,
+            "latest": latest["source"],
+            "baseline_rounds": [e["source"] for e in pool],
+            "checks": checks}
+
+
+# -- report ---------------------------------------------------------------
+
+def _fmt(v, nd=2) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}"
+    return str(v)
+
+
+def perf_report(entries: List[Dict[str, Any]]) -> List[str]:
+    """PERF.md-style markdown: bench trajectory, the latest row's phase
+    breakdown, and its per-kernel cost attribution (when present)."""
+    lines = ["# PERF report (generated by `make perf-report` — "
+             "proovread_tpu.obs.regress)", ""]
+    lines += ["## Bench trajectory", "",
+              "| round | source | bases/s/chip | wall_s | config | "
+              "identity_after | note |",
+              "|---|---|---|---|---|---|---|"]
+    for e in entries:
+        row = e["row"] or {}
+        note = ""
+        if not _usable(e):
+            note = ("timeout" if row.get("timeout")
+                    else f"no row (rc={e['rc']})")
+        lines.append(
+            f"| {_fmt(e['n'])} | {e['source']} | {_fmt(row.get('value'))} "
+            f"| {_fmt(row.get('wall_s'))} | {_fmt(row.get('config'))} "
+            f"| {_fmt(row.get('identity_after'), 4)} | {note} |")
+    lines.append("")
+
+    attributed = [e for e in entries
+                  if isinstance(e["row"], dict) and e["row"].get("phases")]
+    if attributed:
+        e = attributed[-1]
+        lines += [f"## Phase breakdown — {e['source']}", "",
+                  "| phase | count | total_s | compile_s | GFLOP | GB | "
+                  "peak MB |", "|---|---|---|---|---|---|---|"]
+        for cat, ph in sorted((e["row"]["phases"] or {}).items(),
+                              key=lambda kv: -kv[1].get("total_s", 0)):
+            lines.append(
+                f"| {cat} | {_fmt(ph.get('count'))} "
+                f"| {_fmt(ph.get('total_s'))} "
+                f"| {_fmt(ph.get('compile_s'))} "
+                f"| {_fmt((ph.get('flops') or 0) / 1e9, 3)} "
+                f"| {_fmt((ph.get('bytes_accessed') or 0) / 1e9, 3)} "
+                f"| {_fmt((ph.get('peak_bytes') or 0) / 2**20, 1)} |")
+        lines.append("")
+    else:
+        lines += ["## Phase breakdown", "",
+                  "_no attributed bench rows yet (rows predate the PR-3 "
+                  "phases schema, or every attributed run failed)_", ""]
+
+    kerneled = [e for e in entries
+                if isinstance(e["row"], dict) and e["row"].get("kernels")]
+    if kerneled:
+        e = kerneled[-1]
+        lines += [f"## Kernel cost attribution — {e['source']}", "",
+                  "| kernel | calls | GFLOP | GB | FLOP/B | exec_s | "
+                  "peak MB |", "|---|---|---|---|---|---|---|"]
+        for name, k in sorted((e["row"]["kernels"] or {}).items(),
+                              key=lambda kv: -kv[1].get("exec_s", 0)):
+            fl = k.get("flops") or 0.0
+            by = k.get("bytes_accessed") or 0.0
+            lines.append(
+                f"| {name} | {_fmt(k.get('calls'))} "
+                f"| {_fmt(fl / 1e9, 3)} | {_fmt(by / 1e9, 3)} "
+                f"| {_fmt(fl / by if by else 0.0)} "
+                f"| {_fmt(k.get('exec_s'))} "
+                f"| {_fmt((k.get('peak_bytes') or 0) / 2**20, 1)} |")
+        lines.append("")
+    else:
+        lines += ["## Kernel cost attribution", "",
+                  "_no bench rows carry the PR-4 `kernels` attribution "
+                  "yet — the next `make bench` run will_", ""]
+    return lines
+
+
+# -- CLI ------------------------------------------------------------------
+
+def _resolve_paths(args_paths: List[str]) -> List[str]:
+    if args_paths:
+        return args_paths
+    return sorted(_glob.glob("BENCH_*.json"))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proovread-tpu-perf",
+        description="Perf-regression gate / report over BENCH_*.json "
+                    "history (docs/OBSERVABILITY.md).")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    chk = sub.add_parser("check", help="gate: exit 1 on regression")
+    rep = sub.add_parser("report", help="PERF.md-style markdown to stdout")
+    for p in (chk, rep):
+        p.add_argument("files", nargs="*",
+                       help="bench history files (default: BENCH_*.json)")
+    chk.add_argument("--value-threshold", type=float,
+                     default=VALUE_THRESHOLD,
+                     help="allowed fractional bases/sec drop "
+                          f"(default {VALUE_THRESHOLD})")
+    chk.add_argument("--phase-threshold", type=float,
+                     default=PHASE_THRESHOLD,
+                     help="allowed fractional per-phase wall growth "
+                          f"(default {PHASE_THRESHOLD})")
+    chk.add_argument("--min-abs-s", type=float, default=MIN_ABS_S,
+                     help="minimum absolute seconds of growth to count "
+                          f"(default {MIN_ABS_S})")
+    chk.add_argument("--window", type=int, default=BASELINE_WINDOW,
+                     help="rolling-baseline row count "
+                          f"(default {BASELINE_WINDOW})")
+    args = ap.parse_args(argv)
+    paths = _resolve_paths(args.files)
+    if not paths:
+        print("perf: no bench history files found", file=sys.stderr)
+        return 0 if args.cmd == "check" else 1
+    entries = load_rows(paths)
+
+    if args.cmd == "report":
+        print("\n".join(perf_report(entries)))
+        return 0
+
+    verdict = perf_check(entries,
+                         value_threshold=args.value_threshold,
+                         phase_threshold=args.phase_threshold,
+                         min_abs_s=args.min_abs_s,
+                         window=args.window)
+    for c in verdict["checks"]:
+        if c["status"] == "regressed":
+            print(f"PERF-REGRESSION: {c['check']} = {c['value']} vs "
+                  f"baseline {c['baseline']} "
+                  f"({c['delta_frac']:+.1%}, threshold "
+                  f"{c['threshold']:.0%})", file=sys.stderr)
+        elif c["status"] == "missing":
+            print(f"perf-check: missing — {c.get('note', c)}",
+                  file=sys.stderr)
+    print(json.dumps(verdict, sort_keys=True))
+    if verdict["verdict"] == "REGRESSION":
+        return 1
+    print(f"perf-check: {verdict['verdict']} "
+          f"(latest {verdict['latest']} vs "
+          f"{len(verdict['baseline_rounds'])} baseline row(s))",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

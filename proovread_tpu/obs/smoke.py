@@ -2,9 +2,15 @@
 
 Runs a small full CLI correction with ``--trace`` and ``--metrics-out``
 and validates both artifacts: the trace must parse against the Chrome
-trace-event schema with its root span ≥95% covered by children and every
-bucket span carrying the compile/execute split; the metrics JSON must
-parse against the registry schema and contain the KPI counter catalog.
+trace-event schema with its root span ≥95% covered by children, every
+bucket span carrying the compile/execute split AND the PR-4 cost/memory
+attribution (flops / bytes_accessed / peak_bytes from
+``Compiled.cost_analysis()``/``memory_analysis()``, live_bytes /
+peak_live_bytes from the span-boundary memory sampler); the metrics JSON
+must parse against the registry schema and contain the KPI counter
+catalog. The run is additionally wrapped in a live-array leak check
+(``obs.memory.LeakCheck``): device arrays parked in module state by the
+pipeline fail the smoke.
 
 Workload: the F.antasticus reference sample when present
 (``/root/reference/sample``), else a synthetic genome with the same
@@ -83,15 +89,19 @@ def main(argv=None) -> int:
         out = os.path.join(tmp, "out")
         trace = os.path.join(tmp, "run.trace.jsonl")
         mets = os.path.join(tmp, "run.metrics.json")
-        _log("running CLI with --trace/--metrics-out")
+        _log("running CLI with --trace/--metrics-out (+ leak check)")
+        from proovread_tpu.obs.memory import LeakCheck
+        leak = LeakCheck()
         rc = cli_main(["-l", lp, "-s", sp, "-p", out, "-m", "sr-noccs",
                        "-c", cfgp, "--trace", trace,
                        "--metrics-out", mets])
         if rc != 0:
             _log(f"CLI exited {rc}")
             return 1
+        lrep = leak.report()
         try:
-            tstats = validate_trace(trace, min_coverage=0.95)
+            tstats = validate_trace(trace, min_coverage=0.95,
+                                    require_attribution=True)
             mstats = validate_metrics(mets, require=REQUIRED_COUNTERS)
         except ValidationError as e:
             _log(f"FAILED: {e}")
@@ -99,8 +109,16 @@ def main(argv=None) -> int:
         if tstats["n_buckets"] < 1:
             _log("FAILED: no bucket spans in trace")
             return 1
+        if tstats["bucket_flops"] <= 0 or tstats["bucket_bytes"] <= 0:
+            _log("FAILED: bucket spans carry zero total cost attribution "
+                 f"({json.dumps(tstats)}) — the profiler did not run")
+            return 1
+        if lrep["leaked_bytes"] > 1 << 20:
+            _log(f"FAILED: live-array leak after the run: {lrep}")
+            return 1
         _log(f"trace OK: {json.dumps(tstats)}")
         _log(f"metrics OK: {json.dumps(mstats)}")
+        _log(f"leak check OK: {json.dumps(lrep)}")
         _log("PASS")
     return 0
 

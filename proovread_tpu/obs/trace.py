@@ -51,6 +51,63 @@ _BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
 # span categories whose args always carry the compile/execute split
 _SPLIT_CATS = frozenset(("bucket", "attempt", "pass", "kernel"))
 
+# span categories sampled by the device-memory telemetry (obs/memory.py):
+# coarse-grained on purpose — a live-array walk per kernel span would turn
+# the sampler itself into the hot path it is meant to observe
+_MEM_CATS = frozenset(("bucket", "attempt", "pass", "task"))
+
+# cross-module switches set by obs.profile / obs.memory (module-level so
+# trace.py never imports them — the off path must stay import-free):
+# _profile_active: cost attribution is on -> _SPLIT_CATS spans always emit
+#   the flops/bytes/peak keys (even when 0, so readers see the schema)
+# _annotate: wrap every span in a jax.profiler.TraceAnnotation so XLA op
+#   traces (--xprof) line up with the span tree
+# _mem_sampler: obs.memory sampler called at _MEM_CATS span exits
+# _suspend_compile: the profiler's own lower().compile() calls fire
+#   backend_compile events that are attribution overhead, not pipeline
+#   compiles — they must not pollute span compile_ms / n_compiles
+_profile_active = False
+_annotate = False
+_mem_sampler = None
+_suspend_compile = False
+# obs.profile's backend-compile listener (the profiler subtracts compile
+# seconds from its per-call exec_s window); set via
+# set_profile_compile_listener so trace.py never imports profile
+_profile_compile_cb = None
+
+
+def set_profile_active(on: bool) -> None:
+    global _profile_active
+    _profile_active = bool(on)
+
+
+def set_profile_compile_listener(cb) -> None:
+    global _profile_compile_cb
+    _profile_compile_cb = cb
+
+
+def set_annotations(on: bool) -> None:
+    global _annotate
+    _annotate = bool(on)
+
+
+def set_memory_sampler(sampler) -> None:
+    global _mem_sampler
+    _mem_sampler = sampler
+
+
+@contextmanager
+def suspended_compile_attribution():
+    """Scope in which backend_compile events are ignored (the profiler's
+    attribution compiles would otherwise count as pipeline cache misses)."""
+    global _suspend_compile
+    prev = _suspend_compile
+    _suspend_compile = True
+    try:
+        yield
+    finally:
+        _suspend_compile = prev
+
 
 class _NoopSpan:
     """Shared do-nothing span: returned by :func:`span` while tracing is
@@ -156,9 +213,13 @@ def _install_monitoring_hook() -> None:
         from jax import monitoring
 
         def _on_duration(event, duration, **kw):
+            if _suspend_compile or event != _BACKEND_COMPILE:
+                return
             t = _tracer
-            if t is not None and event == _BACKEND_COMPILE:
+            if t is not None:
                 t._on_compile(event, float(duration))
+            if _profile_compile_cb is not None:
+                _profile_compile_cb(float(duration))
 
         monitoring.register_event_duration_secs_listener(_on_duration)
     except Exception:                                   # noqa: BLE001
@@ -171,7 +232,8 @@ class Span:
     records a Chrome ``X`` (complete) event at exit."""
 
     __slots__ = ("_tracer", "name", "cat", "args", "depth", "compile_s",
-                 "dur_s", "_start", "_fence_obj")
+                 "dur_s", "_start", "_fence_obj", "flops", "bytes_acc",
+                 "peak_bytes", "mem_peak", "_ann")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str,
                  args: Dict[str, Any]):
@@ -182,6 +244,13 @@ class Span:
         self.compile_s = 0.0
         self.dur_s = 0.0
         self._fence_obj = None
+        # cost attribution (obs/profile.py): accumulated over every
+        # profiled entry point launched while this span is open
+        self.flops = 0.0
+        self.bytes_acc = 0.0
+        self.peak_bytes = 0.0       # max single-program peak inside span
+        self.mem_peak = 0.0         # max sampled live bytes inside span
+        self._ann = None
 
     def set(self, **args):
         self.args.update(args)
@@ -197,6 +266,13 @@ class Span:
         t = self._tracer
         self.depth = len(t._stack)
         t._stack.append(self)
+        if _annotate:
+            try:        # --xprof: name the XLA op-trace slice after us
+                from jax.profiler import TraceAnnotation
+                self._ann = TraceAnnotation(f"{self.cat}:{self.name}")
+                self._ann.__enter__()
+            except Exception:                           # noqa: BLE001
+                self._ann = None
         self._start = t._clock()
         return self
 
@@ -208,11 +284,28 @@ class Span:
                 jax.block_until_ready(self._fence_obj)
             except Exception:                           # noqa: BLE001
                 pass                # fence is attribution, never a fault
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:                           # noqa: BLE001
+                pass
+            self._ann = None
         end = t._clock()
         if t._stack and t._stack[-1] is self:
             t._stack.pop()
         elif self in t._stack:      # mismatched exit (exception unwinding)
             t._stack.remove(self)
+        if _mem_sampler is not None and self.cat in _MEM_CATS \
+                and exc_type is None:
+            # AFTER the end timestamp and the stack pop: the sampler's own
+            # live_arrays()/memory_stats() walk must not inflate this
+            # span's duration (phase total_s feeds the perf-regression
+            # gate); ancestors still accrue it in their wall time, which
+            # is honest — it did happen inside them
+            try:        # span-boundary device-memory sample (obs/memory)
+                _mem_sampler.sample(self, t)
+            except Exception:                           # noqa: BLE001
+                pass                # telemetry, never a fault
         self.dur_s = end - self._start
         args = dict(self.args)
         args["depth"] = self.depth
@@ -223,6 +316,23 @@ class Span:
             args["compile_ms"] = round(comp * 1e3, 3)
             args["execute_ms"] = round(
                 max(self.dur_s - comp, 0.0) * 1e3, 3)
+        if self.flops or self.bytes_acc or self.peak_bytes or (
+                _profile_active and self.cat in _SPLIT_CATS):
+            # cost attribution (obs/profile.py): emitted whenever any
+            # profiled program launched inside the span — and on every
+            # _SPLIT_CATS span while profiling is on, so readers can tell
+            # "no device work" (zeros) from "attribution off" (absent)
+            args["flops"] = self.flops
+            args["bytes_accessed"] = self.bytes_acc
+            args["peak_bytes"] = self.peak_bytes
+        if self.mem_peak or (_mem_sampler is not None
+                             and self.cat in _MEM_CATS):
+            # like the cost keys: while the sampler is installed, sampled
+            # categories always carry the key — a 0 means "nothing live"
+            # (legal, e.g. all-replayed --resume buckets), absence means
+            # "telemetry off"; validate_trace(require_attribution=True)
+            # relies on that distinction
+            args["peak_live_bytes"] = self.mem_peak
         if exc_type is not None:
             args["error"] = exc_type.__name__
         t.events.append({
@@ -257,6 +367,17 @@ class Tracer:
         for sp in self._stack:      # attribute to every open span: the
             sp.compile_s += duration  # bucket split must include children
 
+    def _on_cost(self, flops: float, bytes_acc: float,
+                 peak_bytes: float) -> None:
+        """Attribute one profiled program launch (obs/profile.py) to every
+        open span — like compiles, the bucket totals must include their
+        children's work. ``peak_bytes`` is a max, not a sum: concurrent
+        peaks don't stack, the largest program bounds the span."""
+        for sp in self._stack:
+            sp.flops += flops
+            sp.bytes_acc += bytes_acc
+            sp.peak_bytes = max(sp.peak_bytes, peak_bytes)
+
     # -- serialization ----------------------------------------------------
     def write_chrome(self, path: str) -> None:
         """Chrome trace-event JSONL: one event object per line (Perfetto
@@ -269,7 +390,10 @@ class Tracer:
                 fh.write(json.dumps(ev) + "\n")
 
     def phase_totals(self) -> Dict[str, Dict[str, float]]:
-        """Per-category aggregation (bench's per-phase breakdown)."""
+        """Per-category aggregation (bench's per-phase breakdown). When
+        cost attribution ran (obs/profile.py), each phase also carries its
+        flops / bytes_accessed / peak_bytes totals — the schema the
+        perf-regression gate (obs/regress.py) compares across rounds."""
         out: Dict[str, Dict[str, float]] = {}
         for ev in self.events:
             ph = out.setdefault(ev["cat"],
@@ -278,6 +402,13 @@ class Tracer:
             ph["count"] += 1
             ph["total_s"] += ev["dur"] / 1e6
             ph["compile_s"] += ev["args"].get("compile_ms", 0.0) / 1e3
+            a = ev["args"]
+            if "flops" in a:
+                ph["flops"] = ph.get("flops", 0.0) + a["flops"]
+                ph["bytes_accessed"] = (ph.get("bytes_accessed", 0.0)
+                                        + a.get("bytes_accessed", 0.0))
+                ph["peak_bytes"] = max(ph.get("peak_bytes", 0.0),
+                                       a.get("peak_bytes", 0.0))
         for ph in out.values():
             ph["total_s"] = round(ph["total_s"], 4)
             ph["compile_s"] = round(ph["compile_s"], 4)

@@ -35,8 +35,15 @@ def _fail(msg: str):
     raise ValidationError(msg)
 
 
-def validate_trace(path: str, min_coverage: float = 0.0) -> Dict[str, Any]:
-    """Validate a trace JSONL file; returns summary stats."""
+def validate_trace(path: str, min_coverage: float = 0.0,
+                   require_attribution: bool = False) -> Dict[str, Any]:
+    """Validate a trace JSONL file; returns summary stats.
+
+    ``require_attribution``: the trace must come from a profiled run —
+    every bucket span has to carry the cost keys (``flops``,
+    ``bytes_accessed``, ``peak_bytes``) and the memory-telemetry keys
+    (``live_bytes``, ``peak_live_bytes``). Zero values are legal (a
+    replayed bucket does no device work); absent keys are not."""
     events = []
     with open(path) as fh:
         for lineno, line in enumerate(fh, 1):
@@ -73,6 +80,29 @@ def validate_trace(path: str, min_coverage: float = 0.0) -> Dict[str, Any]:
         if "compile_ms" not in b["args"] or "execute_ms" not in b["args"]:
             _fail(f"{path}: bucket span {b['name']!r} lacks the "
                   "compile_ms/execute_ms split")
+    total_flops = total_bytes = peak_live = 0.0
+    if require_attribution:
+        cost_keys = ("flops", "bytes_accessed", "peak_bytes")
+        mem_keys = ("live_bytes", "peak_live_bytes")
+        for b in buckets:
+            missing_c = [k for k in cost_keys if k not in b["args"]]
+            missing_m = [k for k in mem_keys if k not in b["args"]]
+            if missing_c:
+                _fail(f"{path}: bucket span (bucket="
+                      f"{b['args'].get('bucket')}) lacks cost "
+                      f"attribution keys {missing_c}")
+            if missing_m:
+                _fail(f"{path}: bucket span (bucket="
+                      f"{b['args'].get('bucket')}) lacks memory "
+                      f"telemetry keys {missing_m}")
+            for k in cost_keys + mem_keys:
+                if not isinstance(b["args"][k], (int, float)) \
+                        or b["args"][k] < 0:
+                    _fail(f"{path}: bucket attribution {k} must be a "
+                          f">=0 number, got {b['args'][k]!r}")
+            total_flops += b["args"]["flops"]
+            total_bytes += b["args"]["bytes_accessed"]
+            peak_live = max(peak_live, b["args"]["peak_live_bytes"])
 
     roots = [e for e in events if e["args"]["depth"] == 0]
     if not roots:
@@ -86,7 +116,7 @@ def validate_trace(path: str, min_coverage: float = 0.0) -> Dict[str, Any]:
     if coverage < min_coverage:
         _fail(f"{path}: root span {root['name']!r} children cover "
               f"{coverage:.1%} of its wall time (< {min_coverage:.0%})")
-    return {
+    stats = {
         "n_events": len(events),
         "root": root["name"],
         "wall_s": round(root["dur"] / 1e6, 3),
@@ -96,6 +126,11 @@ def validate_trace(path: str, min_coverage: float = 0.0) -> Dict[str, Any]:
             e["args"].get("compile_ms", 0.0) for e in events
             if e["args"]["depth"] == 0) / 1e3, 3),
     }
+    if require_attribution:
+        stats["bucket_flops"] = total_flops
+        stats["bucket_bytes"] = total_bytes
+        stats["peak_live_bytes"] = peak_live
+    return stats
 
 
 def validate_metrics(path: str,
@@ -148,6 +183,9 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics", help="metrics JSON file")
     ap.add_argument("--min-coverage", type=float, default=0.0,
                     help="minimum root-span child coverage (0..1)")
+    ap.add_argument("--require-attribution", action="store_true",
+                    help="bucket spans must carry the cost/memory "
+                         "attribution keys (profiled runs)")
     ap.add_argument("--require", default="",
                     help="comma-separated counter names that must exist")
     args = ap.parse_args(argv)
@@ -155,7 +193,9 @@ def main(argv=None) -> int:
         ap.error("need --trace and/or --metrics")
     try:
         if args.trace:
-            stats = validate_trace(args.trace, args.min_coverage)
+            stats = validate_trace(
+                args.trace, args.min_coverage,
+                require_attribution=args.require_attribution)
             print(f"trace OK: {json.dumps(stats)}")
         if args.metrics:
             req: Tuple[str, ...] = tuple(

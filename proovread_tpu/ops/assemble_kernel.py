@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from proovread_tpu.obs import profile as obs_profile
 from proovread_tpu.ops.votes import INS_CAP as INS_K
 
 
@@ -70,6 +71,7 @@ def _assemble_kernel(len_ref, in_ref, out_ref, nlen_ref, *, Lp):
     nlen_ref[0, b] = jnp.minimum(cur, Lp)
 
 
+@obs_profile.attributed("assemble_rows")
 @functools.partial(jax.jit, static_argnames=("Lp", "interpret"))
 def assemble_rows(call, lengths, Lp: int, interpret: bool = False):
     """Packed scalar-walk replacement for the searchsorted device_assemble:
@@ -205,6 +207,7 @@ def _hcr_kernel(len_ref, pv_ref, q_ref, bits_ref, count_ref, *, Lp):
         emit_run(ms, me)
 
 
+@obs_profile.attributed("hcr_mask_rows")
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def hcr_mask_rows(qual, lengths, pv, interpret: bool = False):
     """Scalar-walk twin of ``dcorrect.device_hcr_mask_dyn``: same params

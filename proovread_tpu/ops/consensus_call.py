@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from proovread_tpu.consensus.params import MAX_PHRED, PROOVREAD_CONSTANT
+from proovread_tpu.obs import profile as obs_profile
 from proovread_tpu.ops.encode import GAP
 from proovread_tpu.ops.pileup import Pileup
 
@@ -48,6 +49,7 @@ def freqs_to_phreds(freq: jnp.ndarray) -> jnp.ndarray:
     return jnp.minimum(p, MAX_PHRED).astype(jnp.int32)
 
 
+@obs_profile.attributed("call_consensus")
 @functools.partial(jax.jit, static_argnames=("max_ins_length",))
 def call_consensus(
     pile: Pileup,

@@ -50,6 +50,7 @@ class VoteStream(NamedTuple):
     run_end_len: jnp.ndarray  # i32 [R, T] run length at forward run end, else 0
 
 
+@obs.profile.attributed("fused_accumulate")
 @functools.partial(
     jax.jit,
     static_argnames=("qual_weighted", "taboo_frac", "taboo_abs", "min_aln_length"),
@@ -209,6 +210,7 @@ def fused_accumulate(
     return Pileup(counts, ins_mbase, ins_len_votes, ins_base_votes)
 
 
+@obs.profile.attributed("add_ref_votes")
 @jax.jit
 def add_ref_votes(pile: Pileup, ref_codes: jnp.ndarray, ref_qual: jnp.ndarray,
                   length_mask: jnp.ndarray) -> Pileup:

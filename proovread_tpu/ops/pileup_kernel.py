@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from proovread_tpu.obs import profile as obs_profile
 from proovread_tpu.ops.votes import INS_CAP, PACK_LANES
 
 
@@ -55,6 +56,7 @@ def _accum_packed_kernel(read_of_ref, w0_ref, pile_in_ref, packed_ref,
     pile_out_ref[0, pl.ds(w0, n), :] += votes.astype(jnp.float32)
 
 
+@obs_profile.attributed("pileup_accumulate_packed")
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pileup_accumulate_packed(
     pileup_packed: jnp.ndarray,   # f32 [B, Lp, PACK_LANES]
@@ -201,6 +203,7 @@ def _accum_bits_win_kernel(read_of_ref, w0_ref, pile_in_ref, b0_ref, b1_ref,
         wr.wait()
 
 
+@obs_profile.attributed("pileup_accumulate_bits")
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pileup_accumulate_bits(
     pileup_packed: jnp.ndarray,   # bf16 [B, Lp, 2*PACK_LANES]
@@ -298,6 +301,7 @@ def _accum_kernel(read_of_ref, w0_ref, pile_in_ref, votes_ref, pile_out_ref,
     pile_out_ref[0, pl.ds(w0, n), :] += votes_ref[0]
 
 
+@obs_profile.attributed("pileup_accumulate")
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pileup_accumulate(pileup_packed: jnp.ndarray,  # f32 [B, Lp, PACK_LANES]
                       votes: jnp.ndarray,          # f32 [R, n, PACK_LANES]

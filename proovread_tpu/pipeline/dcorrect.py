@@ -577,6 +577,7 @@ def detect_chimera_device(results, ref_lens: np.ndarray, aln: AlnData) -> None:
                                            L_i, cns)
 
 
+@obs.profile.attributed("gather_and_align")
 @functools.partial(
     jax.jit,
     static_argnames=("m", "W", "interpret", "ap", "need_qual"),
@@ -940,13 +941,14 @@ def _fused_pass_entry(*args, **kw):
     return _fused_pass_body(*args, **kw)
 
 
-_fused_pass = functools.partial(
+_fused_pass = obs.profile.attributed("fused_pass")(functools.partial(
     jax.jit,
     static_argnames=("m", "W", "CH", "n_chunks", "ap", "cns", "interpret",
                      "collect", "haplo"),
-)(_fused_pass_entry)
+)(_fused_pass_entry))
 
 
+@obs.profile.attributed("fused_iterations")
 @functools.partial(
     jax.jit,
     static_argnames=("m", "W", "CH", "n_chunks", "ap", "cns", "interpret",

@@ -457,8 +457,13 @@ class Pipeline:
                 Lp = 512 * _bucket_chunks(max(1, -(-want // 512)))
                 key = bucket_key(batch_recs)
                 tb0 = time.monotonic()
+                # bases in the span args: per-bucket cost attribution
+                # (flops/bytes, obs/profile.py) normalizes to per-base
+                # rates without re-deriving read sizes from the journal
                 with obs.span("bucket", cat="bucket", bucket=gi, Lp=Lp,
-                              reads=len(batch_recs)) as bsp:
+                              reads=len(batch_recs),
+                              bases=sum(len(r) for r in batch_recs)) \
+                        as bsp:
                     hit = _replay(key, gi, len(groups))
                     if hit is not None:
                         res_batch, chim = hit
@@ -502,7 +507,9 @@ class Pipeline:
                 key = bucket_key(batch_recs)
                 tb0 = time.monotonic()
                 with obs.span("bucket", cat="bucket", bucket=bi,
-                              reads=len(batch_recs)) as bsp:
+                              reads=len(batch_recs),
+                              bases=sum(len(r) for r in batch_recs)) \
+                        as bsp:
                     hit = _replay(key, bi, len(starts))
                     if hit is not None:
                         res_batch, chim = hit

@@ -64,6 +64,11 @@ class TestCcsCorrect:
             pos += len(seq) + 40
         return recs
 
+    # tier-1 budget (ISSUE 4 satellite): the four costliest CCS e2e runs
+    # (60-95 s each on one core — the Pallas interpreter dominates) move
+    # to the nightly tier; tier-1 keeps the min-subreads gate e2e plus
+    # the cheap parsing/raise units as CCS coverage
+    @pytest.mark.slow
     def test_consensus_improves_identity(self):
         rng = np.random.default_rng(21)
         true = "".join(BASES[i] for i in rng.integers(0, 4, 900))
@@ -92,6 +97,7 @@ class TestCcsCorrect:
         assert pair[0].id in ids and pair[1].id in ids
         assert len(out) == 3
 
+    @pytest.mark.slow
     def test_single_passthrough_and_mixed_order(self):
         rng = np.random.default_rng(22)
         t1 = "".join(BASES[i] for i in rng.integers(0, 4, 700))
@@ -108,6 +114,7 @@ class TestCcsCorrect:
         assert zmw_of(out[0].id) == "m9/1"
         assert out[1].seq == t2                 # untouched pass-through
 
+    @pytest.mark.slow
     def test_ref_selection_longest_of_two(self):
         rng = np.random.default_rng(23)
         true = "".join(BASES[i] for i in rng.integers(0, 4, 600))
@@ -120,6 +127,7 @@ class TestCcsCorrect:
         # reference = the longer subread; output retains its id
         assert out[0].id == long_.id
 
+    @pytest.mark.slow
     def test_ref_selection_second_of_many(self):
         rng = np.random.default_rng(24)
         true = "".join(BASES[i] for i in rng.integers(0, 4, 600))

@@ -1,0 +1,453 @@
+"""PR-4 performance-observability tests: per-kernel cost/memory
+attribution (obs/profile.py), device-memory telemetry + leak check
+(obs/memory.py), the perf-regression gate (obs/regress.py), and the
+zero-overhead guard for the unprofiled path (docs/OBSERVABILITY.md)."""
+
+import functools
+import json
+
+import numpy as np
+import pytest
+
+from proovread_tpu import obs
+from proovread_tpu.obs import memory as obsmem
+from proovread_tpu.obs import metrics as obsm
+from proovread_tpu.obs import profile as obsp
+from proovread_tpu.obs import regress
+from proovread_tpu.obs.validate import ValidationError, validate_trace
+
+
+# --------------------------------------------------------------------------
+# cost attribution units (CPU backend — counts-only roofline)
+# --------------------------------------------------------------------------
+
+def _toy_entry():
+    import jax
+
+    @obsp.attributed("toy_entry")
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def toy(a, b, k: int = 1):
+        return (a @ b) * k
+    return toy
+
+
+class TestCostAttribution:
+    def test_record_schema_and_signature_cache(self):
+        import jax.numpy as jnp
+        toy = _toy_entry()
+        a = jnp.ones((32, 32))
+        with obsp.profiling() as prof:
+            toy(a, a, k=2)
+            toy(a, a, k=2)          # same signature: cached cost model
+            toy(a, a, k=3)          # new static arg: new signature
+        rec = prof.records["toy_entry"]
+        assert rec.calls == 3
+        assert rec.n_signatures == 2
+        assert rec.cost_errors == 0
+        assert rec.flops > 0 and rec.bytes_accessed > 0
+        # CPU memory_analysis works: arg+out+temp(+code) peak estimate
+        assert rec.peak_bytes >= 2 * 32 * 32 * 4
+        assert rec.exec_s > 0
+        d = prof.as_dict()["toy_entry"]
+        for key in ("calls", "flops", "bytes_accessed", "peak_bytes",
+                    "exec_s", "compile_s", "n_signatures", "cost_errors"):
+            assert key in d, key
+
+    def test_in_window_compile_split_out_of_exec(self):
+        """A backend compile landing inside the call window must move
+        from exec_s to compile_s (cold-cache first calls would otherwise
+        deflate the roofline's achieved rates)."""
+        import jax
+        from jax import monitoring
+
+        @obsp.attributed("toy_split")
+        @jax.jit
+        def noisy(x):
+            # simulate the backend compile the first real call would fire
+            monitoring.record_event_duration_secs(
+                "/jax/core/compile/backend_compile_duration", 0.05)
+            return x + 1
+
+        import jax.numpy as jnp
+        with obsp.profiling() as prof:
+            jax.block_until_ready(noisy(jnp.ones(8)))
+        rec = prof.records["toy_split"]
+        # the 0.05 s event is clamped to the actual call window, so all
+        # we can assert exactly: it moved out of exec_s, into compile_s
+        assert 0.0 < rec.compile_s <= 0.05 + 1e-3
+        assert rec.exec_s >= 0.0
+
+    def test_span_and_metrics_attribution(self):
+        """Cost lands on every open span (bucket totals include children)
+        and mirrors into kernel_* metrics."""
+        import jax.numpy as jnp
+        toy = _toy_entry()
+        a = jnp.ones((16, 16))
+        with obs.tracing() as tr, obsm.scope() as reg, obsp.profiling():
+            with obs.span("bucket", cat="bucket", bucket=0):
+                with obs.span("p", cat="pass"):
+                    toy(a, a, k=1)
+        by_cat = {e["cat"]: e for e in tr.events}
+        for cat in ("bucket", "pass"):
+            args = by_cat[cat]["args"]
+            assert args["flops"] > 0
+            assert args["bytes_accessed"] > 0
+            assert args["peak_bytes"] > 0
+        assert reg.counter("kernel_flops_total").value(fn="toy_entry") > 0
+        assert reg.counter("kernel_bytes_total").value(fn="toy_entry") > 0
+        assert reg.gauge("kernel_peak_bytes").value(fn="toy_entry") > 0
+
+    def test_split_cats_emit_zero_cost_keys_while_profiling(self):
+        """A bucket with no device work still carries the keys (readers
+        must distinguish 'no work' from 'attribution off')."""
+        with obs.tracing() as tr, obsp.profiling():
+            with obs.span("bucket", cat="bucket", bucket=1):
+                pass
+        args = tr.events[0]["args"]
+        assert args["flops"] == 0 and args["bytes_accessed"] == 0
+        # and with profiling OFF the keys are absent
+        with obs.tracing() as tr2:
+            with obs.span("bucket", cat="bucket", bucket=1):
+                pass
+        assert "flops" not in tr2.events[0]["args"]
+
+    def test_under_jit_trace_is_passthrough(self):
+        """An attributed entry called inside another jit trace must inline
+        without capturing (its cost belongs to the outer program)."""
+        import jax
+        import jax.numpy as jnp
+        toy = _toy_entry()
+
+        @jax.jit
+        def outer(x):
+            return toy(x, x, k=2).sum()
+
+        with obsp.profiling() as prof:
+            jax.block_until_ready(outer(jnp.ones((8, 8))))
+        assert "toy_entry" not in prof.records
+
+    def test_profiler_compiles_not_counted_as_pipeline_compiles(self):
+        """The attribution lower().compile() fires backend_compile events;
+        they must not inflate the tracer's n_compiles/span compile_ms."""
+        from jax import monitoring
+        with obs.tracing() as tr:
+            with obs.span("s", cat="pass"):
+                from proovread_tpu.obs import trace as obs_trace
+                with obs_trace.suspended_compile_attribution():
+                    monitoring.record_event_duration_secs(
+                        "/jax/core/compile/backend_compile_duration", 9.0)
+        assert tr.n_compiles == 0
+        assert tr.events[0]["args"]["compile_ms"] == 0.0
+
+    def test_donated_args_survive_attribution(self):
+        """Signature specs are taken before the call: a donated input's
+        dead buffer must not break the cost capture."""
+        import jax
+        import jax.numpy as jnp
+
+        @obsp.attributed("toy_donate")
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def bump(x):
+            return x + 1
+
+        with obsp.profiling() as prof:
+            out = bump(jnp.zeros(64))
+            out2 = bump(out)        # donate the previous output
+        assert float(out2[0]) == 2.0
+        rec = prof.records["toy_donate"]
+        assert rec.calls == 2 and rec.flops > 0 and rec.cost_errors == 0
+
+    def test_roofline_lines_counts_only_on_cpu(self):
+        import jax.numpy as jnp
+        toy = _toy_entry()
+        with obsp.profiling() as prof:
+            toy(jnp.ones((16, 16)), jnp.ones((16, 16)), k=1)
+        lines = obsp.roofline_lines(prof)       # CPU: no peak columns
+        assert any("toy_entry" in ln for ln in lines)
+        assert any("counts-only" in ln for ln in lines)
+        assert "%peakF" not in lines[0]
+        # known backend: peak columns appear
+        lines_tpu = obsp.roofline_lines(prof, device_kind="TPU v5 lite")
+        assert "%peakF" in lines_tpu[0]
+        assert obsp.device_peaks("TPU v4") == obsp.DEVICE_PEAKS["tpu v4"]
+        assert obsp.device_peaks("cpu") is None
+
+    def test_phase_totals_carry_cost(self):
+        import jax.numpy as jnp
+        toy = _toy_entry()
+        with obs.tracing() as tr, obsp.profiling():
+            with obs.span("bucket", cat="bucket", bucket=0):
+                toy(jnp.ones((16, 16)), jnp.ones((16, 16)), k=1)
+        ph = tr.phase_totals()["bucket"]
+        assert ph["flops"] > 0 and ph["bytes_accessed"] > 0
+
+
+# --------------------------------------------------------------------------
+# device-memory telemetry + leak check
+# --------------------------------------------------------------------------
+
+class TestMemoryTelemetry:
+    def test_live_bytes_counts_arrays(self):
+        import jax.numpy as jnp
+        base = obsmem.live_bytes()
+        x = jnp.ones((256, 256), jnp.float32)
+        assert obsmem.live_bytes() >= base + x.nbytes
+        del x
+
+    def test_sampler_annotates_spans_and_gauges(self):
+        import jax.numpy as jnp
+        keep = jnp.ones((128, 128))
+        with obs.tracing() as tr, obsm.scope() as reg:
+            obsmem.install()
+            try:
+                with obs.span("bucket", cat="bucket", bucket=0):
+                    with obs.span("p", cat="pass"):
+                        pass
+            finally:
+                obsmem.uninstall()
+        by_cat = {e["cat"]: e for e in tr.events}
+        for cat in ("bucket", "pass"):
+            assert by_cat[cat]["args"]["live_bytes"] >= keep.nbytes
+        # the pass sample rolled up into the bucket's peak
+        assert by_cat["bucket"]["args"]["peak_live_bytes"] >= keep.nbytes
+        assert reg.gauge("peak_live_bytes").value() >= keep.nbytes
+        assert reg.gauge("bucket_peak_live_bytes").value(bucket=0) \
+            >= keep.nbytes
+        del keep
+
+    def test_sampler_off_means_no_span_keys(self):
+        with obs.tracing() as tr:
+            with obs.span("bucket", cat="bucket", bucket=0):
+                pass
+        assert "live_bytes" not in tr.events[0]["args"]
+
+    def test_leak_check_clean_and_injected(self):
+        import jax.numpy as jnp
+        # positive: transient arrays do not leak
+        lc = obsmem.LeakCheck()
+        y = (jnp.arange(1024.0) * 2).block_until_ready()
+        del y
+        rep = lc.report()
+        assert rep["leaked_bytes"] == 0, rep
+        # negative: a held reference is reported with its size
+        lc2 = obsmem.LeakCheck()
+        z = jnp.ones((512, 512), jnp.float32).block_until_ready()
+        rep2 = lc2.report()
+        assert rep2["n_leaked"] >= 1
+        assert rep2["leaked_bytes"] >= z.nbytes
+        assert any("512" in ex for ex in rep2["examples"])
+        with pytest.raises(AssertionError, match="live-array leak"):
+            lc2.assert_clean(tolerate_bytes=1024)
+        del z
+        assert lc2.report()["leaked_bytes"] == 0
+
+
+# --------------------------------------------------------------------------
+# perf-regression gate (synthetic histories)
+# --------------------------------------------------------------------------
+
+def _row(value=100_000.0, wall=40.0, config=3, phases="default", **kw):
+    if phases == "default":
+        phases = {"bucket": {"count": 10, "total_s": 30.0,
+                             "compile_s": 0.1},
+                  "pass": {"count": 40, "total_s": 25.0,
+                           "compile_s": 0.1}}
+    d = {"metric": "corrected_bases_per_sec_per_chip",
+         "unit": "bases/sec/chip", "value": value, "wall_s": wall,
+         "config": config, "phases": phases}
+    d.update(kw)
+    return d
+
+
+def _entries(*rows):
+    return [{"source": f"BENCH_r{i:02d}.json", "n": i, "rc": 0, "row": r}
+            for i, r in enumerate(rows, 1)]
+
+
+class TestPerfRegress:
+    def test_clean_history_passes(self):
+        v = regress.perf_check(_entries(_row(), _row(), _row(),
+                                        _row(value=104_000.0)))
+        assert v["verdict"] == "PASS"
+        assert all(c["status"] in ("ok", "skipped") for c in v["checks"])
+
+    def test_value_regression_flagged(self):
+        v = regress.perf_check(_entries(_row(), _row(), _row(),
+                                        _row(value=60_000.0)))
+        assert v["verdict"] == "REGRESSION"
+        bad = [c for c in v["checks"] if c["status"] == "regressed"]
+        assert [c["check"] for c in bad] == ["value:bases_per_sec"]
+
+    def test_phase_regression_flagged(self):
+        slow = {"bucket": {"count": 10, "total_s": 55.0, "compile_s": 0.1},
+                "pass": {"count": 40, "total_s": 25.0, "compile_s": 0.1}}
+        v = regress.perf_check(_entries(_row(), _row(), _row(),
+                                        _row(phases=slow)))
+        assert v["verdict"] == "REGRESSION"
+        assert any(c["check"] == "phase:bucket"
+                   and c["status"] == "regressed" for c in v["checks"])
+        # the healthy phase stays ok
+        assert any(c["check"] == "phase:pass" and c["status"] == "ok"
+                   for c in v["checks"])
+
+    def test_small_absolute_phase_growth_is_noise(self):
+        """min_abs_s: a 10 ms phase doubling must not trip the gate."""
+        tiny = {"io": {"count": 1, "total_s": 0.01, "compile_s": 0.0}}
+        rows = [_row(phases=tiny)] * 3 + [_row(phases={
+            "io": {"count": 1, "total_s": 0.02, "compile_s": 0.0}})]
+        v = regress.perf_check(_entries(*rows))
+        assert v["verdict"] == "PASS"
+
+    def test_missing_phase_is_reported_not_fatal(self):
+        v = regress.perf_check(_entries(_row(), _row(),
+                                        _row(phases=None)))
+        assert v["verdict"] == "PASS"
+        missing = [c for c in v["checks"] if c["status"] == "missing"]
+        assert {c["check"] for c in missing} == {"phase:bucket",
+                                                "phase:pass"}
+
+    def test_timeout_and_dead_rows_skipped_as_missing(self):
+        entries = _entries(_row(), _row(), _row(value=101_000.0))
+        entries.insert(2, {"source": "BENCH_dead.json", "n": 99, "rc": 1,
+                           "row": None})
+        entries.insert(3, {"source": "BENCH_to.json", "n": 98, "rc": 124,
+                           "row": _row(value=None, timeout=True)})
+        v = regress.perf_check(entries)
+        assert v["verdict"] == "PASS"
+        assert sum(1 for c in v["checks"]
+                   if c["check"] == "row" and c["status"] == "missing") \
+            == 2
+
+    def test_config_mismatch_has_no_baseline(self):
+        v = regress.perf_check(_entries(_row(config=1), _row(config=1),
+                                        _row(config=3, value=10.0)))
+        assert v["verdict"] == "PASS"
+        assert any(c["check"] == "baseline" and c["status"] == "skipped"
+                   for c in v["checks"])
+
+    def test_no_data_verdict(self):
+        v = regress.perf_check([{"source": "x", "n": 1, "rc": 1,
+                                 "row": None}])
+        assert v["verdict"] == "NO-DATA"
+
+    def test_load_rows_wrapper_and_bare_formats(self, tmp_path):
+        p1 = tmp_path / "BENCH_r01.json"
+        p1.write_text(json.dumps({"n": 1, "rc": 0, "parsed": _row()}))
+        p2 = tmp_path / "BENCH_r02.json"
+        p2.write_text(json.dumps(_row(value=99_000.0)) + "\n")
+        p3 = tmp_path / "BENCH_r03.json"
+        p3.write_text(json.dumps({"n": 3, "rc": 124, "parsed": None}))
+        entries = regress.load_rows([str(p1), str(p2), str(p3)])
+        assert len(entries) == 3
+        by_src = {e["source"]: e for e in entries}
+        assert by_src[str(p1)]["row"]["value"] == 100_000.0
+        assert by_src[str(p2)]["row"]["value"] == 99_000.0
+        assert by_src[str(p3)]["row"] is None
+        # numbered rounds keep history order; un-numbered rows sort last
+        assert entries[0]["source"] == str(p1)
+        assert entries[-1]["source"] == str(p2)
+
+    def test_cli_check_and_report(self, tmp_path, capsys):
+        files = []
+        for i, r in enumerate([_row(), _row(), _row(),
+                               _row(value=50_000.0)], 1):
+            p = tmp_path / f"BENCH_r{i:02d}.json"
+            p.write_text(json.dumps({"n": i, "rc": 0, "parsed": r}))
+            files.append(str(p))
+        assert regress.main(["check"] + files) == 1
+        out = capsys.readouterr()
+        assert "PERF-REGRESSION" in out.err
+        verdict = json.loads(out.out.strip().splitlines()[-1])
+        assert verdict["verdict"] == "REGRESSION"
+        assert regress.main(["check"] + files[:3]) == 0
+        assert regress.main(["report"] + files) == 0
+        rep = capsys.readouterr().out
+        assert "Bench trajectory" in rep and "Phase breakdown" in rep
+
+
+# --------------------------------------------------------------------------
+# zero-overhead guard: the unprofiled pipeline path must never touch
+# cost-analysis or memory-stats machinery (attribution is lazy + opt-in)
+# --------------------------------------------------------------------------
+
+def test_zero_overhead_unprofiled_path(monkeypatch):
+    """With no profiler/sampler installed, a pipeline run must perform no
+    cost-analysis, lowering, blocking, or live-array walks — timed bench
+    runs rely on the untraced path being byte-identical to pre-obs
+    dispatch. Any call into the capture machinery fails the test."""
+    from proovread_tpu.io.records import SeqRecord
+    from proovread_tpu.ops.encode import decode_codes
+    from proovread_tpu.pipeline import Pipeline, PipelineConfig, TrimParams
+
+    def _boom(*a, **k):                                 # noqa: ANN001
+        raise AssertionError("attribution machinery ran while disabled")
+
+    monkeypatch.setattr(obsp.Profiler, "call", _boom)
+    monkeypatch.setattr(obsmem.MemorySampler, "sample", _boom)
+    monkeypatch.setattr(obsmem, "live_bytes", _boom)
+
+    assert obsp.current() is None and obsmem.current() is None
+    rng = np.random.default_rng(11)
+    genome = rng.integers(0, 4, 400).astype(np.int8)
+    longs = [SeqRecord(f"r{i}", decode_codes(genome[s:s + 200]))
+             for i, s in enumerate((0, 100))]
+    srs = [SeqRecord(f"s{i}", decode_codes(genome[s:s + 100]),
+                     qual=np.full(100, 30, np.uint8))
+           for i, s in enumerate(rng.integers(0, 300, 30))]
+    res = Pipeline(PipelineConfig(
+        mode="sr", n_iterations=1, sampling=False, engine="scan",
+        batch_reads=8, trim=TrimParams(min_length=100))).run(longs, srs)
+    assert len(res.untrimmed) == 2
+
+
+# --------------------------------------------------------------------------
+# end-to-end: profiled device run (slow tier — the fast units above are
+# the tier-1 coverage for the attribution schema)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.heavy
+class TestProfiledPipelineE2E:
+    def test_device_run_bucket_attribution(self, tmp_path):
+        """Acceptance shape: a traced+profiled CPU run attaches flops /
+        bytes / peak-memory / live-bytes attribution to every bucket span
+        and validate_trace(require_attribution=True) accepts it."""
+        from proovread_tpu.io.records import SeqRecord
+        from proovread_tpu.ops.encode import decode_codes
+        from proovread_tpu.pipeline import (Pipeline, PipelineConfig,
+                                            TrimParams)
+        rng = np.random.default_rng(63)
+        genome = rng.integers(0, 4, 600).astype(np.int8)
+        longs = [SeqRecord(f"r{i}",
+                           decode_codes(genome[s:s + 300]))
+                 for i, s in enumerate((0, 120, 250))]
+        srs = [SeqRecord(f"s{i}", decode_codes(genome[s:s + 100]),
+                         qual=np.full(100, 30, np.uint8))
+               for i, s in enumerate(rng.integers(0, 500, 40))]
+        with obs.tracing() as tr, obsm.scope() as reg, obsp.profiling() \
+                as prof:
+            obsmem.install()
+            try:
+                Pipeline(PipelineConfig(
+                    mode="sr", n_iterations=1, sampling=False,
+                    engine="device", device_chunk=128, batch_reads=8,
+                    trim=TrimParams(min_length=150))).run(longs, srs)
+            finally:
+                obsmem.uninstall()
+        assert prof.records, "no profiled entry points captured"
+        p = str(tmp_path / "t.jsonl")
+        tr.write_chrome(p)
+        stats = validate_trace(p, min_coverage=0.9,
+                               require_attribution=True)
+        assert stats["bucket_flops"] > 0
+        assert stats["bucket_bytes"] > 0
+        assert stats["peak_live_bytes"] > 0
+        assert reg.gauge("peak_live_bytes").value() > 0
+        # unprofiled trace fails the attribution requirement
+        with obs.tracing() as tr2:
+            with obs.span("bucket", cat="bucket", bucket=0):
+                pass
+        p2 = str(tmp_path / "t2.jsonl")
+        tr2.write_chrome(p2)
+        with pytest.raises(ValidationError, match="attribution|telemetry"):
+            validate_trace(p2, require_attribution=True)
