@@ -4,12 +4,13 @@
 #   make test         adds interpret-mode kernel/device suites
 #   make test-all     everything incl. @slow nightly parity runs
 #   make test-faults  fault-injection resilience suite
-#   make trace-smoke  end-to-end --trace/--metrics-out + schema validation
+#   make trace-smoke  end-to-end --trace/--metrics-out/--qc-out + schema validation
+#   make qc-smoke     end-to-end --qc-out + per-read QC schema validation
 #   make perf-check   perf-regression gate over the BENCH_*.json history
 #   make perf-report  PERF.md-style phase/kernel tables from that history
 #   make bench        the benchmark itself (one JSON row on stdout)
 
-.PHONY: smoke test test-all test-faults trace-smoke perf-check perf-report bench
+.PHONY: smoke test test-all test-faults trace-smoke qc-smoke perf-check perf-report bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -31,14 +32,23 @@ test-all:
 test-faults:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults
 
-# observability tier: a full CLI run with --trace/--metrics-out, then
-# schema-validation of both artifacts (root span >=95% covered, bucket
-# spans carry the compile/execute split AND the PR-4 cost/memory
-# attribution — flops, bytes accessed, peak bytes, live bytes — plus the
-# end-of-run live-array leak check) — docs/OBSERVABILITY.md. Uses the
-# F.antasticus sample when present, else a synthetic workload; runs on CPU.
+# observability tier: a full CLI run with --trace/--metrics-out/--qc-out,
+# then schema-validation of all three artifacts (root span >=95% covered,
+# bucket spans carry the compile/execute split AND the PR-4 cost/memory
+# attribution — flops, bytes accessed, peak bytes, live bytes — the
+# per-read QC JSONL validates strictly with records linked to bucket span
+# ids, plus the end-of-run live-array leak check) — docs/OBSERVABILITY.md.
+# Uses the F.antasticus sample when present, else a synthetic workload;
+# runs on CPU.
 trace-smoke:
 	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.smoke
+
+# correction-QC tier: the same workload with only --qc-out (no tracing,
+# no fencing cost); asserts a schema-valid per-read QC artifact — every
+# record carries the full field set, a finish, and a masked-fraction
+# trajectory (docs/OBSERVABILITY.md "Correction QC")
+qc-smoke:
+	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.smoke --qc-only
 
 # perf-regression gate (docs/OBSERVABILITY.md): newest usable BENCH row vs
 # a rolling baseline — headline bases/sec, wall, and per-phase deltas.

@@ -14,9 +14,11 @@ Observability (docs/OBSERVABILITY.md): ``--trace FILE`` writes the span
 tree as Chrome trace-event JSONL (loadable in Perfetto) and logs an
 end-of-run summary table, a per-kernel cost/memory roofline, and a
 live-array leak report; ``--metrics-out FILE`` dumps the typed KPI
-counters as one JSON object; ``--xprof DIR`` additionally wraps the run in
-``jax.profiler.trace`` with span-named TraceAnnotations; ``--log-json``
-emits one structured JSON log record per line for scrapers.
+counters as one JSON object; ``--qc-out FILE`` writes per-read
+correction-QC provenance JSONL plus an aggregate QC report; ``--xprof
+DIR`` additionally wraps the run in ``jax.profiler.trace`` with
+span-named TraceAnnotations; ``--log-json`` emits one structured JSON
+log record per line for scrapers.
 """
 
 from __future__ import annotations
@@ -97,6 +99,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--metrics-out", metavar="FILE",
                     help="dump the typed KPI counters/gauges/histograms "
                          "as one JSON object (docs/OBSERVABILITY.md)")
+    ap.add_argument("--qc-out", metavar="FILE",
+                    help="write per-read correction-QC provenance as "
+                         "JSONL (one meta line with the aggregate "
+                         "report, then one record per read: masked-frac "
+                         "trajectory, support depth, corrected bases, "
+                         "chimera/siamaera/trim funnel — "
+                         "docs/OBSERVABILITY.md)")
     ap.add_argument("--xprof", metavar="DIR",
                     help="wrap the run in jax.profiler.trace(DIR) with "
                          "TraceAnnotations named after the spans, so XLA "
@@ -249,12 +258,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     # serialization; timed runs stay untraced AND unprofiled.
     trace_path = args.trace or cfg.get("trace-file")
     metrics_path = args.metrics_out or cfg.get("metrics-out")
+    qc_path = args.qc_out or cfg.get("qc-out")
     tracing_on = bool(trace_path or args.xprof)
     tracer = obs.install_tracer() if tracing_on else None
     registry = obs.metrics.install() if metrics_path else None
     profiler = obs.profile.install() if tracing_on else None
     mem_sampler = obs.memory.install() if tracing_on else None
     leak_check = obs.memory.LeakCheck() if tracing_on else None
+    qc_recorder = obs.qc.install() if qc_path else None
     xprof_cm = None
     if args.xprof:
         # a failed profiler-session start (unwritable dir, session already
@@ -277,6 +288,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 obs.uninstall_tracer()
             if registry is not None:
                 obs.metrics.uninstall()
+            if qc_recorder is not None:
+                obs.qc.uninstall()
             raise
         log.info("xprof: XLA op trace -> %s (TraceAnnotations follow the "
                  "span tree)", args.xprof)
@@ -322,6 +335,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # the one-shot CLI (the normal case) exits immediately
                 # after this anyway.
                 _queue_leak_report(leak_check)
+        if qc_recorder is not None:
+            obs.qc.uninstall()
+            try:
+                # written even on a crashed run: the partial per-read
+                # records say exactly which reads' provenance completed
+                qc_agg = qc_recorder.aggregate()
+                qc_recorder.write_jsonl(qc_path, agg=qc_agg)
+                log.info("qc: %d per-read record(s) -> %s",
+                         len(qc_recorder.records), qc_path)
+                for ln in qc_recorder.report_lines(agg=qc_agg):
+                    log.info("%s", ln)
+            except OSError as e:
+                log.warning("qc write failed: %s", e)
         if registry is not None:
             obs.metrics.uninstall()
             try:

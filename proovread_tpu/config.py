@@ -174,6 +174,9 @@ DEFAULTS: Dict[str, Any] = {
     # --metrics-out flag overrides. null = no dump (metrics are still
     # embedded in PipelineResult.metrics per run)
     "metrics-out": None,
+    # per-read correction-QC provenance JSONL + aggregate report
+    # (obs/qc.py); the CLI --qc-out flag overrides. null = QC off
+    "qc-out": None,
 }
 
 _COMMENT_RE = re.compile(r"^\s*//.*$", re.M)

@@ -10,6 +10,10 @@
   roofline vs. per-backend peaks) attached to spans and metrics.
 - ``obs.memory`` — device-memory telemetry sampled at span boundaries
   plus an end-of-run live-array leak check.
+- ``obs.qc`` — per-read correction-quality provenance (masked-fraction
+  trajectories, support depth, corrected-base/phred-uplift counts,
+  chimera/siamaera/trim funnel) serialized as ``--qc-out`` JSONL plus
+  an aggregate QC report.
 
 Both are off by default (shared no-op singletons) and are enabled by the
 CLI ``--trace`` / ``--metrics-out`` flags, the ``trace-file`` /
@@ -17,7 +21,7 @@ CLI ``--trace`` / ``--metrics-out`` flags, the ``trace-file`` /
 ``obs.tracing()`` / ``obs.metrics.scope()``. See docs/OBSERVABILITY.md.
 """
 
-from proovread_tpu.obs import memory, metrics, profile
+from proovread_tpu.obs import memory, metrics, profile, qc
 from proovread_tpu.obs.profile import profiling
 from proovread_tpu.obs.trace import (NOOP_SPAN, Span, Tracer, count_retrace,
                                      enabled, span, tracing)
@@ -26,7 +30,8 @@ from proovread_tpu.obs.trace import install as install_tracer
 from proovread_tpu.obs.trace import uninstall as uninstall_tracer
 
 __all__ = [
-    "metrics", "memory", "profile", "profiling", "span", "Span", "Tracer",
+    "metrics", "memory", "profile", "qc", "profiling", "span", "Span",
+    "Tracer",
     "tracing", "enabled", "count_retrace", "current_tracer",
     "install_tracer", "uninstall_tracer", "NOOP_SPAN",
 ]

@@ -1,0 +1,392 @@
+"""Correction-quality observability: per-read QC provenance + aggregate.
+
+proovread's value proposition is *accuracy* — iterative consensus, HCR
+masking, chimera detection, quality trimming (PAPER.md) — yet the span
+tracer and metrics registry (PR 3/4) can attribute every FLOP and byte
+without being able to say what happened to a single read. This module
+records one provenance record per long read as it flows through the
+pipeline:
+
+- identity: read id, input length, bucket ordinal, bucket span id
+  (linking the record into the ``--trace`` span tree),
+- the per-iteration masked-fraction trajectory (HCR mask columns /
+  read length after each correction pass, fused or eager),
+- finish-pass support: admitted short-read alignment count and mean
+  column coverage depth,
+- correction deltas: corrected-base count (substituted + inserted +
+  deleted vs each pass's input) and phred-uplift count (columns whose
+  called phred exceeds the input phred), accumulated over all passes,
+- chimera breakpoints (coordinates + scores), siamaera hits, CCS
+  provenance, and the trim/split funnel (pieces, bases lost per stage).
+
+**Zero overhead when off.** Like ``obs.metrics``, nothing records unless
+a :class:`QcRecorder` is installed (CLI ``--qc-out``, config ``qc-out``,
+or :func:`scope`): pipeline sites check :func:`current` / :func:`enabled`
+and skip both the host bookkeeping and the cheap per-row device
+reductions that feed it (guarded by a tier-1 test mirroring PR 4's
+zero-overhead guard).
+
+**Determinism.** Every numeric field either is an integer count computed
+identically on all ladder rungs, or is derived on the host from
+integer-exact device sums (float32 sums of integer-valued series stay
+exact below 2^24) — so records are identical across the fused / eager /
+host-scan rungs and across ``--resume`` replays (the checkpoint journal
+persists each bucket's records; see ``pipeline/resilience.py``).
+
+Serialization (``--qc-out FILE``): JSONL — one meta line
+(``{"qc_schema": 1, "n_reads": N, "aggregate": {...}}``) followed by one
+record object per read. The record schema is declared *independently* in
+``obs/validate.py`` (``QC_RECORD_FIELDS``) and validated strictly — an
+undeclared field fails validation, so the writer can never silently
+drift from the schema (tests/test_qc.py::TestQcSchema::test_schema_never_drifts).
+"""
+
+from __future__ import annotations
+
+import json
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+QC_SCHEMA_VERSION = 1
+
+# number of fixed-width bins in the aggregate histograms
+_N_BINS = 10
+
+# funnel-table keys of the aggregate report, in render order — also the
+# catalog the pipeline pre-declares as qc_* gauges (driver._declare_metrics)
+FUNNEL_KEYS = (
+    "reads", "reads_corrected", "bases_in", "bases_corrected",
+    "chimera_reads", "chimera_breakpoints", "split_pieces",
+    "pieces_dropped", "bases_lost_chimera", "bases_lost_trim",
+    "bases_out", "siamaera_trimmed", "siamaera_dropped", "ccs_primary",
+    "corrected_bases", "phred_uplift",
+)
+
+
+def new_record(read_id: str) -> Dict[str, Any]:
+    """A fresh per-read record with every schema field present (the
+    writer emits ALL fields on every record; ``validate.QC_RECORD_FIELDS``
+    is the independent declaration the lint guard compares against)."""
+    return {
+        "id": read_id,
+        "bucket": None,            # length-bucket ordinal (None: not bucketed)
+        "bucket_span": None,       # span_id of the bucket span (None: untraced)
+        "in_len": 0,               # input read length entering the pipeline
+        "out_len": 0,              # corrected (untrimmed) length
+        "n_iterations": 0,         # correction passes before finish
+        "masked_frac": [],         # per-iteration HCR-masked fraction
+        "finish_admitted": 0,      # SR alignments admitted at the finish pass
+        "mean_support": 0.0,       # mean finish column coverage depth
+        "corrected_bases": 0,      # subs+ins+dels accumulated over all passes
+        "phred_uplift": 0,         # columns whose called phred rose vs input
+        "chimera": [],             # [[from, to, score], ...] breakpoints
+        "siamaera": None,          # {"action","start","len"} or None
+        "ccs": None,               # {"role","n_subreads"} or None
+        "trim": None,              # funnel: pieces / bases lost per stage
+    }
+
+
+class QcRecorder:
+    """Per-read QC provenance collector for one run.
+
+    Records are keyed by read id and created lazily (a CCS or trim event
+    can precede the bucket entry). All ``record_*`` methods are cheap
+    host bookkeeping over data the pipeline already fetched — the device
+    reductions feeding them live in ``pipeline/dcorrect.py`` and run only
+    while a recorder is installed."""
+
+    def __init__(self):
+        self.records: Dict[str, Dict[str, Any]] = {}
+
+    # -- record construction ---------------------------------------------
+    def _rec(self, read_id: str) -> Dict[str, Any]:
+        r = self.records.get(read_id)
+        if r is None:
+            r = self.records[read_id] = new_record(read_id)
+        return r
+
+    def start_bucket(self, bucket: int, records: Sequence,
+                     span_id: Optional[int] = None) -> None:
+        """Bucket entry: create/refresh the identity fields of every read
+        in the bucket (id, input length, bucket ordinal, bucket span)."""
+        for rec in records:
+            r = self._rec(rec.id)
+            r["bucket"] = int(bucket)
+            r["bucket_span"] = span_id
+            r["in_len"] = len(rec)
+
+    def record_pass(self, read_ids: Sequence[str],
+                    masked_counts, lengths) -> None:
+        """One correction pass: append each read's masked fraction
+        (integer masked-column count / post-pass length, divided HERE so
+        fused/eager/host rungs produce bit-identical floats)."""
+        for i, rid in enumerate(read_ids):
+            r = self._rec(rid)
+            n = int(lengths[i])
+            r["masked_frac"].append(
+                round(int(masked_counts[i]) / max(n, 1), 9))
+            r["n_iterations"] = len(r["masked_frac"])
+
+    def record_edits(self, read_ids: Sequence[str], edits, uplift) -> None:
+        """Accumulate per-read corrected-base and phred-uplift counts
+        (integer deltas of one or more passes)."""
+        for i, rid in enumerate(read_ids):
+            r = self._rec(rid)
+            r["corrected_bases"] += int(edits[i])
+            r["phred_uplift"] += int(uplift[i])
+
+    def record_finish(self, read_ids: Sequence[str], out_lens,
+                      admitted, support_sums, support_cols) -> None:
+        """Finish pass: corrected length, admitted alignment count, and
+        mean support depth (integer-exact device sum / column count,
+        divided on the host)."""
+        for i, rid in enumerate(read_ids):
+            r = self._rec(rid)
+            r["out_len"] = int(out_lens[i])
+            r["finish_admitted"] = int(admitted[i])
+            cols = int(support_cols[i])
+            r["mean_support"] = round(
+                float(support_sums[i]) / max(cols, 1), 6)
+
+    def record_chimera(self, read_id: str,
+                       breakpoints: Iterable) -> None:
+        self._rec(read_id)["chimera"] = [
+            [int(f), int(t), round(float(s), 6)]
+            for (f, t, s) in breakpoints]
+
+    def record_ccs(self, read_id: str, role: str, n_subreads: int) -> None:
+        self._rec(read_id)["ccs"] = {"role": role,
+                                     "n_subreads": int(n_subreads)}
+
+    def record_siamaera(self, read_id: str, action: str,
+                        start: int = 0, length: int = 0) -> None:
+        """Siamaera hit. The filter runs on TRIMMED records, whose ids
+        may carry a chimera-split ``.N`` suffix — those resolve back to
+        the parent read's record (one hit per read; a second piece's hit
+        overwrites, which still reads as 'this read was siamaeric')."""
+        rid = read_id
+        if rid not in self.records:
+            base, _, sfx = rid.rpartition(".")
+            if base and sfx.isdigit() and base in self.records:
+                rid = base
+        self._rec(rid)["siamaera"] = {
+            "action": action, "start": int(start), "len": int(length)}
+
+    def record_trim(self, read_id: str, n_pieces: int,
+                    chimera_bases_lost: int, trim_bases_lost: int,
+                    pieces_dropped: int, bases_out: int) -> None:
+        """Final trim funnel for one read: chimera-split piece count,
+        bases lost to the split trim-margins, bases lost to the quality
+        window + min-length filter (dropped pieces count whole), and the
+        surviving base count."""
+        self._rec(read_id)["trim"] = {
+            "pieces": int(n_pieces),
+            "chimera_bases_lost": int(chimera_bases_lost),
+            "trim_bases_lost": int(trim_bases_lost),
+            "pieces_dropped": int(pieces_dropped),
+            "bases_out": int(bases_out),
+        }
+
+    # -- resilience integration ------------------------------------------
+    def snapshot(self, read_ids: Sequence[str]) -> Dict[str, Any]:
+        """Deep-copy the given reads' records for ladder rollback: a
+        demoted attempt's partial trajectories must rewind with the
+        TaskReports and KPI counters (one schema, one truth)."""
+        return {rid: json.loads(json.dumps(self.records[rid]))
+                for rid in read_ids if rid in self.records}
+
+    def restore(self, read_ids: Sequence[str],
+                snap: Dict[str, Any]) -> None:
+        for rid in read_ids:
+            if rid in snap:
+                self.records[rid] = json.loads(json.dumps(snap[rid]))
+            else:
+                self.records.pop(rid, None)
+
+    def bucket_payload(self, read_ids: Sequence[str]) -> List[Dict]:
+        """JSON-safe copies of the given reads' records (checkpoint
+        journal payload)."""
+        return [json.loads(json.dumps(self.records[rid]))
+                for rid in read_ids if rid in self.records]
+
+    def splice(self, payload: Sequence[Dict],
+               span_id: Optional[int] = None) -> None:
+        """Replay a journal bucket's records (``--resume``). The stored
+        ``bucket_span`` pointed into the ORIGINAL run's trace; it is
+        rebound to the replaying run's bucket span so the artifact stays
+        internally consistent (and byte-identical when untraced)."""
+        for r in payload:
+            r = json.loads(json.dumps(r))
+            r["bucket_span"] = span_id
+            self.records[r["id"]] = r
+
+    # -- aggregation ------------------------------------------------------
+    def aggregate(self) -> Dict[str, Any]:
+        """The aggregate QC report embedded in ``PipelineResult.qc`` and
+        rendered at end of run: fixed-bin histograms of final masked
+        fraction, mean support depth and per-read phred uplift, plus the
+        chimera/trim funnel table."""
+        recs = list(self.records.values())
+        n = len(recs)
+
+        def hist(vals, lo=None, hi=None):
+            vals = [float(v) for v in vals]
+            if not vals:
+                return {"min": 0.0, "max": 0.0, "mean": 0.0,
+                        "edges": [], "counts": []}
+            vlo = min(vals) if lo is None else lo
+            vhi = max(vals) if hi is None else hi
+            w = (vhi - vlo) / _N_BINS if vhi > vlo else 1.0
+            counts = [0] * _N_BINS
+            for v in vals:
+                k = min(int((v - vlo) / w), _N_BINS - 1) if vhi > vlo else 0
+                counts[max(k, 0)] += 1
+            return {"min": round(vlo, 6), "max": round(vhi, 6),
+                    "mean": round(sum(vals) / len(vals), 6),
+                    "edges": [round(vlo + k * w, 6)
+                              for k in range(_N_BINS + 1)],
+                    "counts": counts}
+
+        final_frac = [r["masked_frac"][-1] for r in recs
+                      if r["masked_frac"]]
+        trims = [r["trim"] for r in recs if r["trim"] is not None]
+        sia = [r["siamaera"] for r in recs if r["siamaera"] is not None]
+        funnel = {
+            "reads": n,
+            "reads_corrected": sum(1 for r in recs if r["out_len"] > 0),
+            "bases_in": sum(r["in_len"] for r in recs),
+            "bases_corrected": sum(r["out_len"] for r in recs),
+            "chimera_reads": sum(1 for r in recs if r["chimera"]),
+            "chimera_breakpoints": sum(len(r["chimera"]) for r in recs),
+            "split_pieces": sum(t["pieces"] for t in trims),
+            "pieces_dropped": sum(t["pieces_dropped"] for t in trims),
+            "bases_lost_chimera": sum(t["chimera_bases_lost"]
+                                      for t in trims),
+            "bases_lost_trim": sum(t["trim_bases_lost"] for t in trims),
+            "bases_out": sum(t["bases_out"] for t in trims),
+            "siamaera_trimmed": sum(1 for s in sia
+                                    if s["action"] == "trimmed"),
+            "siamaera_dropped": sum(1 for s in sia
+                                    if s["action"] == "dropped"),
+            "ccs_primary": sum(1 for r in recs
+                               if (r["ccs"] or {}).get("role") == "primary"),
+            "corrected_bases": sum(r["corrected_bases"] for r in recs),
+            "phred_uplift": sum(r["phred_uplift"] for r in recs),
+        }
+        return {
+            "schema": QC_SCHEMA_VERSION,
+            "n_reads": n,
+            "histograms": {
+                "masked_frac_final": hist(final_frac, lo=0.0, hi=1.0),
+                "mean_support": hist([r["mean_support"] for r in recs
+                                      if r["out_len"] > 0]),
+                "phred_uplift": hist([r["phred_uplift"] for r in recs
+                                      if r["out_len"] > 0]),
+            },
+            "funnel": funnel,
+        }
+
+    def to_metrics(self, agg: Optional[Dict[str, Any]] = None) -> None:
+        """Publish the aggregate counts into the typed metrics registry
+        (gauges, so re-publication after the siamaera stage is
+        idempotent) — the one-schema contract: the QC report's headline
+        numbers are scrapable next to every other KPI. Pass a
+        precomputed ``aggregate()`` dict to avoid re-walking the
+        records."""
+        from proovread_tpu.obs import metrics
+        if agg is None:
+            agg = self.aggregate()
+        g = metrics.gauge
+        for key, val in agg["funnel"].items():
+            g(f"qc_{key}", unit="", help=f"QC funnel: {key}").set(val)
+        g("qc_masked_frac_final_mean", unit="frac").set(
+            agg["histograms"]["masked_frac_final"]["mean"])
+        g("qc_mean_support_mean", unit="x").set(
+            agg["histograms"]["mean_support"]["mean"])
+
+    # -- serialization ----------------------------------------------------
+    def iter_records(self) -> List[Dict[str, Any]]:
+        """Records in deterministic (insertion) order."""
+        return list(self.records.values())
+
+    def write_jsonl(self, path: str,
+                    agg: Optional[Dict[str, Any]] = None) -> None:
+        """One meta line (schema + aggregate), then one record per line."""
+        if agg is None:
+            agg = self.aggregate()
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"qc_schema": QC_SCHEMA_VERSION,
+                                 "n_reads": agg["n_reads"],
+                                 "aggregate": agg}) + "\n")
+            for r in self.iter_records():
+                fh.write(json.dumps(r) + "\n")
+
+    def report_lines(self,
+                     agg: Optional[Dict[str, Any]] = None) -> List[str]:
+        """End-of-run rendering (the span summary's sibling): the funnel
+        table plus the three headline histograms."""
+        if agg is None:
+            agg = self.aggregate()
+        f = agg["funnel"]
+        lines = [
+            f"qc: {f['reads']} read(s) — {f['bases_in']} bases in, "
+            f"{f['bases_corrected']} corrected, {f['bases_out']} out "
+            f"after trim",
+            f"qc: funnel — {f['chimera_reads']} chimeric read(s) / "
+            f"{f['chimera_breakpoints']} breakpoint(s), "
+            f"{f['split_pieces']} piece(s) ({f['pieces_dropped']} "
+            f"dropped), lost {f['bases_lost_chimera']} chimera / "
+            f"{f['bases_lost_trim']} trim bases; siamaera "
+            f"{f['siamaera_trimmed']} trimmed / "
+            f"{f['siamaera_dropped']} dropped",
+            f"qc: corrections — {f['corrected_bases']} base edit(s), "
+            f"{f['phred_uplift']} phred-uplifted column(s)",
+        ]
+        for name, h in agg["histograms"].items():
+            if not h["counts"]:
+                continue
+            lines.append(
+                f"qc: {name:<20} mean {h['mean']:<10g} "
+                f"[{h['min']:g}..{h['max']:g}]  "
+                + " ".join(str(c) for c in h["counts"]))
+        return lines
+
+
+# -- module-level installation (mirrors obs.metrics) -----------------------
+
+_current: Optional[QcRecorder] = None
+
+
+def current() -> Optional[QcRecorder]:
+    return _current
+
+
+def enabled() -> bool:
+    return _current is not None
+
+
+def install(rec: Optional[QcRecorder] = None) -> QcRecorder:
+    global _current
+    _current = rec if rec is not None else QcRecorder()
+    return _current
+
+
+def uninstall() -> None:
+    global _current
+    _current = None
+
+
+@contextmanager
+def scope(rec: Optional[QcRecorder] = None):
+    """Yield the active recorder, or install a fresh (or given) one for
+    the block — same reuse semantics as ``obs.metrics.scope``."""
+    global _current
+    if rec is None and _current is not None:
+        yield _current
+        return
+    prev = _current
+    _current = rec if rec is not None else QcRecorder()
+    try:
+        yield _current
+    finally:
+        _current = prev

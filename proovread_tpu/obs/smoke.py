@@ -1,16 +1,24 @@
-"""End-to-end observability smoke (``make trace-smoke``).
+"""End-to-end observability smoke (``make trace-smoke`` / ``make
+qc-smoke``).
 
-Runs a small full CLI correction with ``--trace`` and ``--metrics-out``
-and validates both artifacts: the trace must parse against the Chrome
-trace-event schema with its root span ≥95% covered by children, every
-bucket span carrying the compile/execute split AND the PR-4 cost/memory
-attribution (flops / bytes_accessed / peak_bytes from
+Runs a small full CLI correction with ``--trace``, ``--metrics-out`` and
+``--qc-out`` and validates all three artifacts: the trace must parse
+against the Chrome trace-event schema with its root span ≥95% covered by
+children, every bucket span carrying the compile/execute split AND the
+PR-4 cost/memory attribution (flops / bytes_accessed / peak_bytes from
 ``Compiled.cost_analysis()``/``memory_analysis()``, live_bytes /
 peak_live_bytes from the span-boundary memory sampler); the metrics JSON
 must parse against the registry schema and contain the KPI counter
-catalog. The run is additionally wrapped in a live-array leak check
-(``obs.memory.LeakCheck``): device arrays parked in module state by the
-pipeline fail the smoke.
+catalog; the per-read QC JSONL must validate strictly against
+``QC_RECORD_FIELDS`` (records missing required fields — or carrying
+undeclared ones — fail) with one record per corrected read, linked to a
+bucket span id present in the trace. The run is additionally wrapped in
+a live-array leak check (``obs.memory.LeakCheck``): device arrays parked
+in module state by the pipeline fail the smoke.
+
+``--qc-only`` (``make qc-smoke``) runs the same workload with only
+``--qc-out`` — no tracing, so no fencing cost — and validates just the
+QC artifact.
 
 Workload: the F.antasticus reference sample when present
 (``/root/reference/sample``), else a synthetic genome with the same
@@ -74,11 +82,53 @@ def _workload(tmp: str):
     return lp, sp
 
 
+def _validate_qc_artifact(qcp: str, trace: str = None) -> bool:
+    """Validate the --qc-out artifact: strict per-record schema, at least
+    one record, every record finished (out_len > 0, trajectory present),
+    and — when a trace was written — every non-null bucket_span resolves
+    to a bucket span id actually present in the trace."""
+    from proovread_tpu.obs.validate import ValidationError, validate_qc
+
+    try:
+        qstats = validate_qc(qcp, min_reads=1)
+    except ValidationError as e:
+        _log(f"FAILED: {e}")
+        return False
+    unfinished = 0
+    span_ids = set()
+    if trace is not None:
+        with open(trace) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                if ev.get("ph") == "X" and ev.get("cat") == "bucket":
+                    span_ids.add(ev["args"].get("span_id"))
+    with open(qcp) as fh:
+        next(fh)                                # meta line
+        for line in fh:
+            rec = json.loads(line)
+            if rec["out_len"] <= 0 or not rec["masked_frac"]:
+                unfinished += 1
+            if trace is not None and rec["bucket_span"] is not None \
+                    and rec["bucket_span"] not in span_ids:
+                _log(f"FAILED: record {rec['id']!r} links bucket_span "
+                     f"{rec['bucket_span']} absent from the trace")
+                return False
+    if unfinished:
+        _log(f"FAILED: {unfinished} QC record(s) lack a finish "
+             "(out_len == 0 or empty trajectory)")
+        return False
+    _log(f"qc OK: {json.dumps({k: v for k, v in qstats.items() if k != 'aggregate'})}")
+    return True
+
+
 def main(argv=None) -> int:
     from proovread_tpu.cli import main as cli_main
     from proovread_tpu.obs.validate import (ValidationError,
                                             validate_metrics,
                                             validate_trace)
+
+    argv = sys.argv[1:] if argv is None else argv
+    qc_only = "--qc-only" in argv
 
     with tempfile.TemporaryDirectory(prefix="proovread_smoke_") as tmp:
         lp, sp = _workload(tmp)
@@ -89,16 +139,27 @@ def main(argv=None) -> int:
         out = os.path.join(tmp, "out")
         trace = os.path.join(tmp, "run.trace.jsonl")
         mets = os.path.join(tmp, "run.metrics.json")
-        _log("running CLI with --trace/--metrics-out (+ leak check)")
+        qcp = os.path.join(tmp, "run.qc.jsonl")
+        cli_args = ["-l", lp, "-s", sp, "-p", out, "-m", "sr-noccs",
+                    "-c", cfgp, "--qc-out", qcp]
+        if qc_only:
+            _log("running CLI with --qc-out (qc-smoke)")
+        else:
+            _log("running CLI with --trace/--metrics-out/--qc-out "
+                 "(+ leak check)")
+            cli_args += ["--trace", trace, "--metrics-out", mets]
         from proovread_tpu.obs.memory import LeakCheck
         leak = LeakCheck()
-        rc = cli_main(["-l", lp, "-s", sp, "-p", out, "-m", "sr-noccs",
-                       "-c", cfgp, "--trace", trace,
-                       "--metrics-out", mets])
+        rc = cli_main(cli_args)
         if rc != 0:
             _log(f"CLI exited {rc}")
             return 1
         lrep = leak.report()
+        if qc_only:
+            if not _validate_qc_artifact(qcp):
+                return 1
+            _log("PASS")
+            return 0
         try:
             tstats = validate_trace(trace, min_coverage=0.95,
                                     require_attribution=True)
@@ -112,6 +173,8 @@ def main(argv=None) -> int:
         if tstats["bucket_flops"] <= 0 or tstats["bucket_bytes"] <= 0:
             _log("FAILED: bucket spans carry zero total cost attribution "
                  f"({json.dumps(tstats)}) — the profiler did not run")
+            return 1
+        if not _validate_qc_artifact(qcp, trace=trace):
             return 1
         if lrep["leaked_bytes"] > 1 << 20:
             _log(f"FAILED: live-array leak after the run: {lrep}")

@@ -117,6 +117,7 @@ class _NoopSpan:
 
     __slots__ = ()
     dur_s = 0.0
+    span_id = None
 
     def __enter__(self):
         return self
@@ -233,7 +234,7 @@ class Span:
 
     __slots__ = ("_tracer", "name", "cat", "args", "depth", "compile_s",
                  "dur_s", "_start", "_fence_obj", "flops", "bytes_acc",
-                 "peak_bytes", "mem_peak", "_ann")
+                 "peak_bytes", "mem_peak", "_ann", "span_id")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str,
                  args: Dict[str, Any]):
@@ -265,6 +266,10 @@ class Span:
     def __enter__(self):
         t = self._tracer
         self.depth = len(t._stack)
+        # stable per-tracer ordinal: external artifacts (the QC JSONL's
+        # per-read records, obs/qc.py) link back into the trace by this id
+        self.span_id = t._next_span_id
+        t._next_span_id += 1
         t._stack.append(self)
         if _annotate:
             try:        # --xprof: name the XLA op-trace slice after us
@@ -309,6 +314,7 @@ class Span:
         self.dur_s = end - self._start
         args = dict(self.args)
         args["depth"] = self.depth
+        args["span_id"] = self.span_id
         if self.compile_s > 0 or self.cat in _SPLIT_CATS:
             # clamp: a backend compile can straddle a span boundary when
             # dispatch blocks lazily — never report compile > duration
@@ -353,6 +359,7 @@ class Tracer:
         self.t0 = self._clock()
         self.events: List[Dict[str, Any]] = []
         self._stack: List[Span] = []
+        self._next_span_id = 1
         self.n_compiles = 0         # backend_compile events (cache misses)
         self.n_retraces = 0         # count_retrace hook firings
         self.compile_s = 0.0        # total backend-compile seconds

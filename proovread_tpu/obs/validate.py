@@ -8,10 +8,16 @@ statistic the acceptance bar cares about: the fraction of the root span's
 wall time covered by its direct children. ``validate_metrics`` checks the
 metrics JSON against the ``obs.metrics`` schema.
 
-Both are importable (``make trace-smoke``, tests) and runnable::
+``validate_qc`` strictly checks a ``--qc-out`` per-read JSONL artifact
+against the ``QC_RECORD_FIELDS`` schema (undeclared fields fail — the
+writer can never silently drift, tests/test_qc.py).
+
+All are importable (``make trace-smoke`` / ``make qc-smoke``, tests) and
+runnable::
 
     python -m proovread_tpu.obs.validate --trace run.trace.jsonl \
-        --metrics run.metrics.json --min-coverage 0.95 \
+        --metrics run.metrics.json --qc run.qc.jsonl \
+        --min-coverage 0.95 \
         --require admission_dropped_cov,resilience_demotions
 """
 
@@ -25,6 +31,40 @@ from typing import Any, Dict, Iterable, Tuple
 from proovread_tpu.obs.metrics import SCHEMA_VERSION
 
 _REQUIRED_X = ("name", "cat", "ph", "ts", "dur", "pid", "tid", "args")
+
+# -- per-read QC record schema (obs/qc.py writer) --------------------------
+# Declared HERE, independently of the writer, on purpose: validate_qc is
+# STRICT (an undeclared field fails), and the lint-guard test
+# (tests/test_qc.py::TestQcSchema::test_schema_never_drifts) drives every writer
+# path and validates the result — so the writer and this declaration can
+# never silently drift apart. Each entry maps a field name to the tuple
+# of accepted JSON-decoded types.
+_NUM = (int, float)
+_OPT_INT = (int, type(None))
+QC_SCHEMA_VERSION = 1
+QC_RECORD_FIELDS = {
+    "id": (str,),
+    "bucket": _OPT_INT,            # length-bucket ordinal
+    "bucket_span": _OPT_INT,       # span_id of the bucket span (--trace)
+    "in_len": (int,),
+    "out_len": (int,),
+    "n_iterations": (int,),
+    "masked_frac": (list,),        # per-iteration trajectory
+    "finish_admitted": (int,),
+    "mean_support": _NUM,
+    "corrected_bases": (int,),
+    "phred_uplift": (int,),
+    "chimera": (list,),            # [[from, to, score], ...]
+    "siamaera": (dict, type(None)),
+    "ccs": (dict, type(None)),
+    "trim": (dict, type(None)),
+}
+# nested-object schemas, same strictness
+QC_SIAMAERA_FIELDS = {"action": (str,), "start": (int,), "len": (int,)}
+QC_CCS_FIELDS = {"role": (str,), "n_subreads": (int,)}
+QC_TRIM_FIELDS = {"pieces": (int,), "chimera_bases_lost": (int,),
+                  "trim_bases_lost": (int,), "pieces_dropped": (int,),
+                  "bases_out": (int,)}
 
 
 class ValidationError(ValueError):
@@ -175,12 +215,106 @@ def validate_metrics(path: str,
             "n_series": n_series}
 
 
+def validate_qc_record(rec: Dict[str, Any], where: str = "record") -> None:
+    """Strictly validate ONE QC record: every declared field present with
+    an accepted type, no undeclared fields (the schema-drift guard), and
+    structural invariants (trajectory length, breakpoint shape)."""
+    if not isinstance(rec, dict):
+        _fail(f"{where}: not an object")
+    missing = [k for k in QC_RECORD_FIELDS if k not in rec]
+    if missing:
+        _fail(f"{where}: missing required fields {missing}")
+    unknown = [k for k in rec if k not in QC_RECORD_FIELDS]
+    if unknown:
+        _fail(f"{where}: undeclared fields {unknown} — declare them in "
+              "obs/validate.py:QC_RECORD_FIELDS first")
+    for k, types in QC_RECORD_FIELDS.items():
+        if not isinstance(rec[k], types):
+            _fail(f"{where}: field {k!r} has type "
+                  f"{type(rec[k]).__name__}, expected one of "
+                  f"{[t.__name__ for t in types]}")
+    for v in rec["masked_frac"]:
+        if not isinstance(v, _NUM) or not (0.0 <= v <= 1.0):
+            _fail(f"{where}: masked_frac entry {v!r} not in [0, 1]")
+    if rec["n_iterations"] != len(rec["masked_frac"]):
+        _fail(f"{where}: n_iterations {rec['n_iterations']} != trajectory "
+              f"length {len(rec['masked_frac'])}")
+    for bp in rec["chimera"]:
+        if (not isinstance(bp, list) or len(bp) != 3
+                or not all(isinstance(x, _NUM) for x in bp)):
+            _fail(f"{where}: chimera breakpoint {bp!r} is not "
+                  "[from, to, score]")
+    for key, sub_schema in (("siamaera", QC_SIAMAERA_FIELDS),
+                            ("ccs", QC_CCS_FIELDS),
+                            ("trim", QC_TRIM_FIELDS)):
+        sub = rec[key]
+        if sub is None:
+            continue
+        sub_missing = [k for k in sub_schema if k not in sub]
+        sub_unknown = [k for k in sub if k not in sub_schema]
+        if sub_missing or sub_unknown:
+            _fail(f"{where}: {key} object missing {sub_missing} / "
+                  f"undeclared {sub_unknown}")
+        for k, types in sub_schema.items():
+            if not isinstance(sub[k], types):
+                _fail(f"{where}: {key}.{k} has type "
+                      f"{type(sub[k]).__name__}")
+
+
+def validate_qc(path: str, min_reads: int = 0) -> Dict[str, Any]:
+    """Validate a ``--qc-out`` JSONL artifact: one meta line (schema
+    version + embedded aggregate) followed by one strictly-validated
+    record per read. Returns summary stats."""
+    n = 0
+    n_chimeric = 0
+    ids = set()
+    meta = None
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                _fail(f"{path}:{lineno}: not JSON ({e})")
+            if lineno == 1:
+                if not isinstance(obj, dict) \
+                        or obj.get("qc_schema") != QC_SCHEMA_VERSION:
+                    _fail(f"{path}: first line must be the meta record "
+                          f"with qc_schema == {QC_SCHEMA_VERSION}")
+                if not isinstance(obj.get("aggregate"), dict):
+                    _fail(f"{path}: meta record lacks the aggregate "
+                          "report")
+                meta = obj
+                continue
+            validate_qc_record(obj, where=f"{path}:{lineno}")
+            if obj["id"] in ids:
+                _fail(f"{path}:{lineno}: duplicate read id {obj['id']!r}")
+            ids.add(obj["id"])
+            n += 1
+            if obj["chimera"]:
+                n_chimeric += 1
+    if meta is None:
+        _fail(f"{path}: empty artifact (no meta line)")
+    if meta.get("n_reads") != n:
+        _fail(f"{path}: meta n_reads {meta.get('n_reads')} != "
+              f"{n} record line(s)")
+    if n < min_reads:
+        _fail(f"{path}: {n} record(s) < required {min_reads}")
+    return {"n_records": n, "n_chimeric": n_chimeric,
+            "aggregate": meta["aggregate"]}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="proovread-tpu-obs-validate",
         description="Validate --trace / --metrics-out artifacts.")
     ap.add_argument("--trace", help="trace-event JSONL file")
     ap.add_argument("--metrics", help="metrics JSON file")
+    ap.add_argument("--qc", help="per-read QC JSONL file (--qc-out)")
+    ap.add_argument("--min-qc-reads", type=int, default=0,
+                    help="minimum per-read QC record count")
     ap.add_argument("--min-coverage", type=float, default=0.0,
                     help="minimum root-span child coverage (0..1)")
     ap.add_argument("--require-attribution", action="store_true",
@@ -189,8 +323,8 @@ def main(argv=None) -> int:
     ap.add_argument("--require", default="",
                     help="comma-separated counter names that must exist")
     args = ap.parse_args(argv)
-    if not (args.trace or args.metrics):
-        ap.error("need --trace and/or --metrics")
+    if not (args.trace or args.metrics or args.qc):
+        ap.error("need --trace, --metrics and/or --qc")
     try:
         if args.trace:
             stats = validate_trace(
@@ -202,6 +336,9 @@ def main(argv=None) -> int:
                 s for s in args.require.split(",") if s)
             stats = validate_metrics(args.metrics, require=req)
             print(f"metrics OK: {json.dumps(stats)}")
+        if args.qc:
+            stats = validate_qc(args.qc, min_reads=args.min_qc_reads)
+            print(f"qc OK: {json.dumps({k: v for k, v in stats.items() if k != 'aggregate'})}")
     except ValidationError as e:
         print(f"validation FAILED: {e}", file=sys.stderr)
         return 1

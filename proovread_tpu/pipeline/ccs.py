@@ -28,6 +28,7 @@ from proovread_tpu.align.params import AlignParams
 from proovread_tpu.consensus.params import ConsensusParams
 from proovread_tpu.io.batch import pack_reads
 from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.obs import qc as obs_qc
 from proovread_tpu.pipeline.correct import FastCorrector
 
 ZMW_RE = re.compile(r"^(m[^/]+/\d+)/(\d+_\d+)")
@@ -145,6 +146,7 @@ def ccs_correct(
             out_map[ref_idx[j]] = rec
 
     out: List[SeqRecord] = []
+    qrec = obs_qc.current()
     for z in order:
         g = groups[z]
         if z not in ref_of:
@@ -152,10 +154,18 @@ def ccs_correct(
             # passes through unconsensed
             stats.single += len(g)
             out.extend(records[i] for i in g)
+            if qrec is not None:
+                for i in g:
+                    qrec.record_ccs(records[i].id, "single", len(g))
         else:
             stats.primary += 1
             stats.secondary += len(g) - 1
             # if consensus never ran for this ZMW (e.g. empty window batch),
             # pass the raw reference subread through rather than dropping it
-            out.append(out_map.get(ref_of[z], records[ref_of[z]]))
+            rec = out_map.get(ref_of[z], records[ref_of[z]])
+            out.append(rec)
+            if qrec is not None:
+                # QC provenance: this output read is the ZMW's circular
+                # consensus over len(g) subreads
+                qrec.record_ccs(rec.id, "primary", len(g))
     return out, stats

@@ -13,11 +13,13 @@ boundaries.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from proovread_tpu.obs import qc as obs_qc
 
 from proovread_tpu.align import seed as seed_mod
 from proovread_tpu.align.params import AlignParams
@@ -44,6 +46,12 @@ class CorrectionStats:
     # dropped (a silent cap must not read as "covered everything")
     n_eligible: int = 0
     n_dropped_cov: int = 0
+    # per-read QC arrays (obs/qc.py) — populated only while a QC recorder
+    # is installed: 'edits' / 'uplift' (i64 [B] pass deltas), 'admitted'
+    # (i64 [B] admitted alignments per read), 'support_sum' (f32 [B]
+    # integer-exact column-coverage sums). The host twin of
+    # dcorrect.qc_pass_row_stats / qc_finish_support.
+    qc_rows: Optional[Dict[str, Any]] = None
 
 
 class FastCorrector:
@@ -219,10 +227,32 @@ class FastCorrector:
                 results, refs, queries, cand, chunks, admitted,
                 win_start, r_start, r_end, q_start, q_end, score)
 
+        qc_rows = None
+        if obs_qc.enabled():
+            # host twin of dcorrect.qc_pass_row_stats/qc_finish_support:
+            # same formulas over the already-fetched call tensors, so the
+            # host-scan rung's QC records match the device rungs exactly
+            pos = np.arange(L)[None, :]
+            valid = pos < refs.lengths[:, None]
+            em = emitted & valid
+            subs = (em & (base != refs.codes)).sum(1)
+            ins = np.where(em, ins_len, 0).sum(1)
+            dels = (valid & ~emitted).sum(1)
+            uplift = (em & (phred > refs.qual.astype(np.int32))).sum(1)
+            adm_pr = (np.bincount(cand.lread[admitted], minlength=B)
+                      if n_cand else np.zeros(B, np.int64))
+            qc_rows = {
+                "edits": (subs + ins + dels).astype(np.int64),
+                "uplift": uplift.astype(np.int64),
+                "admitted": adm_pr.astype(np.int64),
+                "support_sum": np.where(valid, coverage, 0.0).sum(
+                    1, dtype=np.float32),
+            }
+
         n_adm = int(admitted.sum())
         return results, CorrectionStats(
             n_cand, n_adm, n_eligible=n_eligible,
-            n_dropped_cov=max(0, n_eligible - n_adm))
+            n_dropped_cov=max(0, n_eligible - n_adm), qc_rows=qc_rows)
 
     def _detect_chimera(self, results, refs, queries, cand, chunks, admitted,
                         win_start, r_start, r_end, q_start, q_end, score):

@@ -316,6 +316,60 @@ def device_hcr_mask(qual: jnp.ndarray, lengths: jnp.ndarray, p: MaskParams):
     return device_hcr_mask_dyn(qual, lengths, mask_params_vec(p))
 
 
+# --------------------------------------------------------------------------
+# per-read QC reductions (obs/qc.py) — cheap row reductions piggybacked on
+# tensors a pass already produced; they run ONLY while a QC recorder is
+# installed (zero extra device work when QC is off, guarded by a tier-1
+# test) and return integer-exact values so the fused / eager / host-scan
+# ladder rungs produce bit-identical records.
+# --------------------------------------------------------------------------
+
+@jax.jit
+def qc_row_mask_counts(mask_cols: jnp.ndarray) -> jnp.ndarray:
+    """i32 [B]: HCR-masked columns per read (the per-read numerator of the
+    masked-fraction trajectory; the division happens on the host so every
+    rung derives the float identically)."""
+    return mask_cols.sum(axis=1).astype(jnp.int32)
+
+
+@jax.jit
+def qc_pass_row_stats(call: ConsensusCall, codes: jnp.ndarray,
+                      qual: jnp.ndarray, lengths: jnp.ndarray):
+    """Per-read correction deltas of ONE pass vs its input state:
+
+    - ``edits`` i32 [B]: substituted (emitted base != input base) +
+      inserted (ins_len of emitted columns) + deleted (valid columns not
+      emitted) bases,
+    - ``uplift`` i32 [B]: emitted columns whose called phred exceeds the
+      input phred.
+
+    Column-aligned by construction (``call`` is indexed by the pass's
+    input columns, before assembly shifts coordinates)."""
+    B, L = codes.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    valid = pos < lengths[:, None]
+    em = call.emitted & valid
+    subs = (em & (call.base != codes)).sum(axis=1)
+    ins = jnp.where(em, call.ins_len, 0).sum(axis=1)
+    dels = (valid & ~call.emitted).sum(axis=1)
+    uplift = (em & (call.phred > qual.astype(jnp.int32))).sum(axis=1)
+    return ((subs + ins + dels).astype(jnp.int32),
+            uplift.astype(jnp.int32))
+
+
+@jax.jit
+def qc_finish_support(call: ConsensusCall,
+                      lengths: jnp.ndarray) -> jnp.ndarray:
+    """f32 [B]: summed finish-pass column coverage per read. Coverage
+    counts are integer-valued in the unweighted path, so the f32 sum is
+    exact below 2^24 — the host divides by the column count to get the
+    mean support depth."""
+    B, L = call.coverage.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    valid = pos < lengths[:, None]
+    return jnp.where(valid, call.coverage, 0.0).sum(axis=1)
+
+
 def _pileup_bf16_safe(cns: ConsensusParams) -> bool:
     """The bits-kernel accumulator is bf16, exact for integer counts only up
     to 256 (past that increments round away silently). Admission bins
@@ -953,7 +1007,8 @@ _fused_pass = obs.profile.attributed("fused_pass")(functools.partial(
     jax.jit,
     static_argnames=("m", "W", "CH", "n_chunks", "ap", "cns", "interpret",
                      "n_rest", "Lp", "seed_stride", "seed_min_votes",
-                     "shortcut_frac", "min_gain", "full_set"),
+                     "shortcut_frac", "min_gain", "full_set",
+                     "collect_qc"),
 )
 def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
                      sr_codes, sr_rc, sr_qual, sr_lengths,
@@ -963,7 +1018,7 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
                      interpret: bool, n_rest: int, Lp: int,
                      seed_stride: int, seed_min_votes: int,
                      shortcut_frac: float, min_gain: float,
-                     full_set: bool = False):
+                     full_set: bool = False, collect_qc: bool = False):
     """Iterations 2..N as ONE device program (``lax.while_loop``).
 
     The host loop pays one blocking round trip per pass on the tunneled
@@ -977,7 +1032,13 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
     [n_rest, 6] per-iteration HCR mask params (``mask_params_vec`` —
     early/late iterations mask differently). Returns the final read state
     plus stacked per-iteration (frac, n_cand, n_admitted) and the number
-    of iterations actually run."""
+    of iterations actually run.
+
+    ``collect_qc`` (static; obs/qc.py): additionally carry the per-read
+    QC accumulators — per-iteration masked-column counts + lengths
+    (i32 [n_rest, B]) and run totals of base edits / phred uplift
+    (i32 [B]) — appended to the return tuple. Off (the default) leaves
+    the program identical to the pre-QC one: zero extra device work."""
     obs.count_retrace("fused_iterations")
     B = codes.shape[0]
 
@@ -1015,13 +1076,21 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
             sread, strand, lread, diag, n_cand,
             m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
             interpret=interpret, collect=False)
+        qc_extras = ()
+        if collect_qc:
+            # per-read edit/uplift deltas vs THIS pass's input state —
+            # computed before assembly shifts the column coordinates
+            ed, up = qc_pass_row_stats(call, codes, qual, lengths)
+            qc_extras = (ed, up)
         new_codes, new_qual, new_len = device_assemble(
             call, lengths, Lp, interpret=interpret)
         new_mask, frac = device_hcr_mask_dyn(new_qual, new_len,
                                              mask_pvs[it],
                                              interpret=interpret)
+        if collect_qc:
+            qc_extras = (qc_row_mask_counts(new_mask),) + qc_extras
         return (new_codes, new_qual, new_len, new_mask, frac, n_cand,
-                n_adm, n_elig, n_drop)
+                n_adm, n_elig, n_drop) + qc_extras
 
     def cond(state):
         (_, _, _, _, _, _, it, done, *_rest) = state
@@ -1029,10 +1098,15 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
 
     def body(state):
         (codes, qual, lengths, mask_cols, frac_prev, _gain, it, done,
-         fracs, ncands, nadms, neligs, ndrops) = state
+         fracs, ncands, nadms, neligs, ndrops, *qcs) = state
+        out = one_pass(codes, qual, lengths, mask_cols, it)
         (codes, qual, lengths, mask_cols, frac, n_cand,
-         n_adm, n_elig, n_drop) = one_pass(codes, qual, lengths,
-                                           mask_cols, it)
+         n_adm, n_elig, n_drop) = out[:9]
+        if collect_qc:
+            mrow, ed, up = out[9:]
+            qc_m, qc_l, qc_e, qc_u = qcs
+            qcs = (qc_m.at[it].set(mrow), qc_l.at[it].set(lengths),
+                   qc_e + ed, qc_u + up)
         gain = frac - frac_prev
         done = (frac > shortcut_frac) | (gain < min_gain)
         fracs = fracs.at[it].set(frac)
@@ -1041,21 +1115,27 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
         neligs = neligs.at[it].set(n_elig)
         ndrops = ndrops.at[it].set(n_drop)
         return (codes, qual, lengths, mask_cols, frac, gain, it + 1, done,
-                fracs, ncands, nadms, neligs, ndrops)
+                fracs, ncands, nadms, neligs, ndrops, *qcs)
 
+    qcs0 = ()
+    if collect_qc:
+        qcs0 = (jnp.zeros((n_rest, B), jnp.int32),
+                jnp.zeros((n_rest, B), jnp.int32),
+                jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32))
     init = (codes, qual, lengths, mask_cols, frac_prev, jnp.float32(0),
             jnp.int32(0), jnp.bool_(False),
             jnp.full(n_rest, -1.0, jnp.float32),
             jnp.zeros(n_rest, jnp.int32),
             jnp.zeros(n_rest, jnp.int32),
             jnp.zeros(n_rest, jnp.int32),
-            jnp.zeros(n_rest, jnp.int32))
+            jnp.zeros(n_rest, jnp.int32), *qcs0)
     (codes, qual, lengths, mask_cols, frac, _gain, it, done, fracs,
-     ncands, nadms, neligs, ndrops) = jax.lax.while_loop(cond, body, init)
+     ncands, nadms, neligs, ndrops, *qcs) = jax.lax.while_loop(
+         cond, body, init)
     # ``done`` distinguishes a shortcut that fired on the FINAL scheduled
     # pass from plain schedule exhaustion (the two leave identical ``it``)
     return (codes, qual, lengths, mask_cols, it, fracs, ncands, nadms,
-            neligs, ndrops, done)
+            neligs, ndrops, done, *qcs)
 
 
 def _pad_candidates(sread, strand, lread, diag, R_need: int):

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from proovread_tpu import obs
+from proovread_tpu.obs.qc import FUNNEL_KEYS as QC_FUNNEL_KEYS
 from proovread_tpu.align.params import AlignParams, BWA_SR, BWA_SR_FINISH, BWA_MR, BWA_MR_1, BWA_MR_FINISH
 from proovread_tpu.consensus.engine import ConsensusResult
 from proovread_tpu.consensus.params import ConsensusParams
@@ -136,6 +137,10 @@ class PipelineResult:
     # typed-counter snapshot of the run (obs.metrics schema); always
     # populated by Pipeline.run — docs/OBSERVABILITY.md lists the catalog
     metrics: Optional[Dict[str, Any]] = None
+    # aggregate correction-QC report (obs/qc.py): masked-fraction /
+    # support-depth / uplift histograms + the chimera/trim funnel.
+    # Populated only while a QC recorder is installed (CLI --qc-out).
+    qc: Optional[Dict[str, Any]] = None
 
 
 def _record_report(reports: List[TaskReport], rep: TaskReport) -> None:
@@ -195,6 +200,14 @@ def _declare_metrics(reg) -> None:
     c("jax_retraces", "traces",
       "Python retraces of jitted pipeline functions")
     reg.histogram("bucket_seconds", "s", "wall time per length bucket")
+    # correction-QC aggregate gauges (obs/qc.py): pre-declared so a run
+    # without --qc-out still exposes the schema (zero-valued)
+    for key in QC_FUNNEL_KEYS:
+        reg.gauge(f"qc_{key}", "", f"QC funnel: {key}")
+    reg.gauge("qc_masked_frac_final_mean", "frac",
+              "mean final HCR-masked fraction across reads")
+    reg.gauge("qc_mean_support_mean", "x",
+              "mean finish-pass support depth across reads")
 
 
 def _align_params(mode: str, iteration: Optional[int]) -> AlignParams:
@@ -350,6 +363,13 @@ class Pipeline:
                           mode=self.config.mode,
                           engine=self.config.engine):
                 result = self._run(long_records, short_records)
+            qc_rec = obs.qc.current()
+            if qc_rec is not None:
+                # embed the aggregate QC report + publish its headline
+                # counts as qc_* gauges (run_tasks re-embeds after the
+                # siamaera stage; gauges are idempotent)
+                result.qc = qc_rec.aggregate()
+                qc_rec.to_metrics(result.qc)
             result.metrics = reg.as_dict()
             return result
 
@@ -413,14 +433,22 @@ class Pipeline:
                          "completed bucket(s)", cfg.checkpoint_dir,
                          len(journal.entries))
 
-        def _replay(key, gi, n_groups):
+        qc_rec = obs.qc.current()
+
+        def _replay(key, gi, n_groups, span_id=None):
             """Journal hit: splice the bucket's stored results + reports
-            back in, restore the sampler rotation, and record the resume
-            event in the report stream (never a silent skip)."""
-            hit = journal.get(key) if journal is not None else None
+            (and, with QC on, its per-read QC records) back in, restore
+            the sampler rotation, and record the resume event in the
+            report stream (never a silent skip). With QC on, an entry
+            written without QC records is treated as a miss — the bucket
+            recomputes rather than silently losing its provenance."""
+            hit = (journal.get(key, require_qc=qc_rec is not None)
+                   if journal is not None else None)
             if hit is None:
                 return None
-            res_batch, chim, rep_h, sampler_fc = hit
+            res_batch, chim, rep_h, sampler_fc, qc_payload = hit
+            if qc_rec is not None and qc_payload is not None:
+                qc_rec.splice(qc_payload, span_id=span_id)
             reports.extend(rep_h)
             sampler.first_chunk = sampler_fc
             note = (f"bucket {gi} replayed from checkpoint journal "
@@ -464,19 +492,26 @@ class Pipeline:
                               reads=len(batch_recs),
                               bases=sum(len(r) for r in batch_recs)) \
                         as bsp:
-                    hit = _replay(key, gi, len(groups))
+                    hit = _replay(key, gi, len(groups),
+                                  span_id=bsp.span_id)
                     if hit is not None:
                         res_batch, chim = hit
                         bsp.set(replayed=True)
                     else:
+                        if qc_rec is not None:
+                            qc_rec.start_bucket(gi, batch_recs,
+                                                span_id=bsp.span_id)
                         n_rep0 = len(reports)
                         res_batch, chim = self._run_bucket_resilient(
                             gi, batch_recs, sr_dev, short_records, sampler,
                             coverage, min_sr_len, reports, Lp)
                         if journal is not None:
-                            journal.put(key, gi, res_batch, chim,
-                                        reports[n_rep0:],
-                                        sampler.first_chunk)
+                            journal.put(
+                                key, gi, res_batch, chim,
+                                reports[n_rep0:], sampler.first_chunk,
+                                qc_records=(qc_rec.bucket_payload(
+                                    [r.id for r in batch_recs])
+                                    if qc_rec is not None else None))
                 if hit is None:
                     # COMPUTED buckets only: replays would put ~0s rows in
                     # the latency histogram and make reads/bases disagree
@@ -510,19 +545,26 @@ class Pipeline:
                               reads=len(batch_recs),
                               bases=sum(len(r) for r in batch_recs)) \
                         as bsp:
-                    hit = _replay(key, bi, len(starts))
+                    hit = _replay(key, bi, len(starts),
+                                  span_id=bsp.span_id)
                     if hit is not None:
                         res_batch, chim = hit
                         bsp.set(replayed=True)
                     else:
+                        if qc_rec is not None:
+                            qc_rec.start_bucket(bi, batch_recs,
+                                                span_id=bsp.span_id)
                         n_rep0 = len(reports)
                         res_batch, chim = self._run_batch(
                             batch_recs, sr_all, short_records, sampler,
                             coverage, min_sr_len, reports)
                         if journal is not None:
-                            journal.put(key, bi, res_batch, chim,
-                                        reports[n_rep0:],
-                                        sampler.first_chunk)
+                            journal.put(
+                                key, bi, res_batch, chim,
+                                reports[n_rep0:], sampler.first_chunk,
+                                qc_records=(qc_rec.bucket_payload(
+                                    [r.id for r in batch_recs])
+                                    if qc_rec is not None else None))
                 if hit is None:
                     _bucket_metrics(tb0, batch_recs)
                 results_final.extend(res_batch)
@@ -602,10 +644,14 @@ class Pipeline:
                       if (lv.host or lv.chunk_div == 1
                           or self._level_chunk(lv) != cfg.device_chunk)]
         reg = obs.metrics.current()
+        qc_rec = obs.qc.current()
+        qc_ids = [r.id for r in batch_recs] if qc_rec is not None else []
         for li, level in enumerate(levels):
             n_rep0 = len(reports)
             sampler_fc0 = sampler.first_chunk
             m_snap = reg.snapshot() if reg is not None else None
+            qc_snap = (qc_rec.snapshot(qc_ids)
+                       if qc_rec is not None else None)
             try:
                 with obs.span("attempt", cat="attempt", rung=level.name,
                               bucket=gi), \
@@ -636,6 +682,11 @@ class Pipeline:
                 sampler.first_chunk = sampler_fc0
                 if m_snap is not None:
                     reg.restore(m_snap)
+                if qc_rec is not None:
+                    # the failed attempt's partial per-read trajectories
+                    # rewind with the reports/KPIs — the retried rung
+                    # rebuilds them from scratch
+                    qc_rec.restore(qc_ids, qc_snap)
                 nxt = levels[li + 1]
                 obs.metrics.counter("device_faults", unit="faults").inc(
                     1, kind=kind)
@@ -674,7 +725,8 @@ class Pipeline:
         import jax
         import jax.numpy as jnp
         from proovread_tpu.pipeline.dcorrect import (
-            detect_chimera_device, device_assemble, device_hcr_mask)
+            detect_chimera_device, device_assemble, device_hcr_mask,
+            qc_finish_support, qc_pass_row_stats, qc_row_mask_counts)
         from proovread_tpu.pipeline.resilience import LADDER
 
         cfg = self.config
@@ -695,6 +747,13 @@ class Pipeline:
         masked_frac = -cfg.mask_min_gain_frac
         max_cov = max(int(min(coverage, cfg.sr_coverage)
                           * cfg.coverage_scale + 0.5), 1)
+
+        # correction QC (obs/qc.py): none of the feeding per-row device
+        # reductions run while no recorder is installed (tier-1 guard:
+        # tests/test_qc.py::test_qc_zero_overhead_when_off)
+        qc_rec = obs.qc.current()
+        qc_on = qc_rec is not None
+        qc_ids = lr.ids[:B0]
 
         # -- pass 1: eager, dynamic chunk count (learns the candidate
         # scale + drives bucketing for the fused remainder) ---------------
@@ -723,12 +782,30 @@ class Pipeline:
             return (f" [dropped: {cap} cap, {cov} cov]"
                     if (cap or cov) else "")
 
-        def _pass_report(task, frac, stats, prev_frac, style=""):
+        def _qc_pass_dev(call, in_codes, in_qual, in_len, new_mask,
+                         new_len):
+            """Per-read QC reductions of one eager pass (QC on only):
+            masked-column counts + new lengths + edit/uplift deltas, all
+            left on device to ride the pass's KPI fetch."""
+            ed, up = qc_pass_row_stats(call, in_codes, in_qual, in_len)
+            return (qc_row_mask_counts(new_mask), new_len, ed, up)
+
+        def _pass_report(task, frac, stats, prev_frac, style="",
+                         qc_dev=None):
             """One device_get for an eager pass's KPIs (masked frac +
-            admitted + eligible), TaskReport append, task log line.
-            Returns (new masked_frac, gain vs prev_frac)."""
-            new_frac, n_adm, n_el = jax.device_get(
-                (frac, stats.n_admitted, stats.n_eligible))
+            admitted + eligible — plus, with QC on, the per-read QC rows
+            piggybacked on the same RPC), TaskReport append, task log
+            line. Returns (new masked_frac, gain vs prev_frac)."""
+            if qc_dev is None:
+                new_frac, n_adm, n_el = jax.device_get(
+                    (frac, stats.n_admitted, stats.n_eligible))
+            else:
+                (new_frac, n_adm, n_el), (mrow, nlen, ed, up) = \
+                    jax.device_get(
+                        ((frac, stats.n_admitted, stats.n_eligible),
+                         qc_dev))
+                qc_rec.record_pass(qc_ids, mrow[:B0], nlen[:B0])
+                qc_rec.record_edits(qc_ids, ed[:B0], up[:B0])
             new_frac = float(new_frac)
             d_cov = max(0, int(n_el) - int(n_adm))
             _record_report(reports, TaskReport(
@@ -788,6 +865,7 @@ class Pipeline:
                     if fixed is not None:
                         flex_budget = jnp.minimum(flex_budget, fixed)
                     # stage 2: the same pass with the tightened budget
+                    qc_in = (codes, qual, lengths) if qc_on else None
                     call, stats = dc.correct_pass(
                         codes, qual, lengths, mask_cols, qc, rcq, qq,
                         qlen, ap_i, cns, seed_stride=cfg.seed_stride,
@@ -798,7 +876,9 @@ class Pipeline:
                         qual, lengths, _mask_p(it))
                     masked_frac, gain = _pass_report(
                         f"bwa-{cfg.mode[:2]}-{it}", frac, stats,
-                        masked_frac, " (flex)")
+                        masked_frac, " (flex)",
+                        qc_dev=(_qc_pass_dev(call, *qc_in, mask_cols,
+                                             lengths) if qc_on else None))
                 it += 1
                 if (masked_frac > cfg.mask_shortcut_frac
                         or gain < cfg.mask_min_gain_frac):
@@ -830,6 +910,7 @@ class Pipeline:
                 sel = sampler.select(n_short, coverage, cfg.sr_coverage) \
                     if cfg.sampling else np.arange(n_short)
                 qc, rcq, qq, qlen = sr_dev.take(sel)
+                qc_in = (codes, qual, lengths) if qc_on else None
                 call, stats = dc.correct_pass(
                     codes, qual, lengths, None, qc, rcq, qq, qlen, ap1,
                     cns, seed_stride=cfg.seed_stride)
@@ -838,7 +919,9 @@ class Pipeline:
                                                   _mask_p(1))
                 n_cand_seen = int(stats.n_candidates)
                 masked_frac, gain = _pass_report(
-                    f"bwa-{cfg.mode[:2]}-1", frac, stats, masked_frac)
+                    f"bwa-{cfg.mode[:2]}-1", frac, stats, masked_frac,
+                    qc_dev=(_qc_pass_dev(call, *qc_in, mask_cols,
+                                         lengths) if qc_on else None))
             if (masked_frac > cfg.mask_shortcut_frac
                     or gain < cfg.mask_min_gain_frac):
                 _shortcut(masked_frac, gain)
@@ -861,6 +944,7 @@ class Pipeline:
                                          cfg.sr_coverage) \
                         if cfg.sampling else np.arange(n_short)
                     qc, rcq, qq, qlen = sr_dev.take(sel)
+                    qc_in = (codes, qual, lengths) if qc_on else None
                     call, stats = dc.correct_pass(
                         codes, qual, lengths, mask_cols, qc, rcq, qq,
                         qlen, _align_params_cfg(cfg, it), cns,
@@ -871,7 +955,9 @@ class Pipeline:
                                                       _mask_p(it))
                     masked_frac, gain = _pass_report(
                         f"bwa-{cfg.mode[:2]}-{it}", frac, stats,
-                        masked_frac, " (eager)")
+                        masked_frac, " (eager)",
+                        qc_dev=(_qc_pass_dev(call, *qc_in, mask_cols,
+                                             lengths) if qc_on else None))
                 if (masked_frac > cfg.mask_shortcut_frac
                         or gain < cfg.mask_min_gain_frac):
                     _shortcut(masked_frac, gain)
@@ -930,13 +1016,21 @@ class Pipeline:
                     cns=cns, interpret=dc.interpret, n_rest=n_fused, Lp=Lp,
                     seed_stride=cfg.seed_stride, seed_min_votes=2,
                     shortcut_frac=cfg.mask_shortcut_frac,
-                    min_gain=cfg.mask_min_gain_frac, full_set=full_set)
+                    min_gain=cfg.mask_min_gain_frac, full_set=full_set,
+                    collect_qc=qc_on)
                 codes, qual, lengths, mask_cols = out[:4]
-                # ONE RPC for the whole schedule's KPIs
-                n_done, fracs, ncands, nadms, neligs, ndrops, sc_done = \
-                    jax.device_get(out[4:])
+                # ONE RPC for the whole schedule's KPIs (+ QC rows)
+                if qc_on:
+                    (n_done, fracs, ncands, nadms, neligs, ndrops,
+                     sc_done, f_m, f_l, f_e, f_u) = jax.device_get(out[4:])
+                    qc_rec.record_edits(qc_ids, f_e[:B0], f_u[:B0])
+                else:
+                    (n_done, fracs, ncands, nadms, neligs, ndrops,
+                     sc_done) = jax.device_get(out[4:])
                 fsp.set(passes_run=int(n_done))
             for k in range(int(n_done)):
+                if qc_on:
+                    qc_rec.record_pass(qc_ids, f_m[k][:B0], f_l[k][:B0])
                 masked_frac = float(fracs[k])
                 d_cap = int(ndrops[k])
                 d_cov = max(0, int(neligs[k]) - int(nadms[k]))
@@ -996,8 +1090,19 @@ class Pipeline:
                 pos = jnp.arange(Lp, dtype=jnp.int32)[None, :]
                 ec_dev = jnp.where((pos < lengths[:, None]) & call.emitted,
                                    1 + call.ins_len, 0).astype(jnp.uint8)
-                codes_h, qual_h, nlen_h, ec_h, lens_h = jax.device_get(
-                    (new_codes, new_qual, new_len, ec_dev, lengths))
+                if qc_on:
+                    # per-read finish QC reductions ride the same fetch
+                    qf_ed, qf_up = qc_pass_row_stats(
+                        call, codes, qual, lengths)
+                    qf_sup = qc_finish_support(call, lengths)
+                    ((codes_h, qual_h, nlen_h, ec_h, lens_h),
+                     (qf_ed_h, qf_up_h, qf_sup_h)) = jax.device_get(
+                        ((new_codes, new_qual, new_len, ec_dev, lengths),
+                         (qf_ed, qf_up, qf_sup)))
+                else:
+                    codes_h, qual_h, nlen_h, ec_h, lens_h = \
+                        jax.device_get((new_codes, new_qual, new_len,
+                                        ec_dev, lengths))
             with obs.span("finish-assemble", cat="host"):
                 from proovread_tpu.ops.encode import decode_codes
                 _empty = np.zeros(0, np.float32)
@@ -1012,6 +1117,19 @@ class Pipeline:
                         cigar="", emit_counts=ec_h[i, :int(lens_h[i])]))
             with obs.span("finish-chimera", cat="host"):
                 detect_chimera_device(out, lens_h, aln)
+            if qc_on:
+                # admitted-per-read from the chimera scan's already-
+                # fetched candidate scalars; support from the piggybacked
+                # reductions (division host-side, rung-invariant)
+                adm_pr = np.bincount(
+                    np.asarray(aln.lread)[np.asarray(aln.admitted, bool)],
+                    minlength=lr.codes.shape[0])
+                qc_rec.record_edits(qc_ids, qf_ed_h[:B0], qf_up_h[:B0])
+                qc_rec.record_finish(qc_ids, nlen_h[:B0], adm_pr[:B0],
+                                     qf_sup_h[:B0], lens_h[:B0])
+                for o in out:
+                    if o.chimera:
+                        qc_rec.record_chimera(o.record.id, o.chimera)
             if cfg.debug_dir:
                 import os
                 import re as _re
@@ -1045,6 +1163,13 @@ class Pipeline:
         cfg = self.config
         lr = pack_reads(batch_recs)
         B, L = lr.codes.shape
+
+        # correction QC (obs/qc.py): host-path twin of the device-engine
+        # recording — same fields, same integer-count derivations, so the
+        # host-scan ladder rung emits identical records
+        qc_rec = obs.qc.current()
+        qc_on = qc_rec is not None
+        qc_ids = list(lr.ids)
 
         cur_codes = lr.codes.copy()
         cur_quals: List[np.ndarray] = [lr.qual[i] for i in range(B)]
@@ -1099,6 +1224,14 @@ class Pipeline:
                       else cfg.hcr_mask_late).scaled(min_sr_len)
                 mask_codes, mcrs, new_frac = mask_batch(
                     cur_codes, cur_quals, cur_lengths, mp)
+                if qc_on:
+                    qc_rec.record_pass(
+                        qc_ids,
+                        [sum(ln for (_off, ln) in mcrs[i])
+                         for i in range(B)],
+                        cur_lengths)
+                    qc_rec.record_edits(qc_ids, stats.qc_rows["edits"],
+                                        stats.qc_rows["uplift"])
                 gain = new_frac - masked_frac
                 masked_frac = new_frac
                 _record_report(reports, TaskReport(
@@ -1139,6 +1272,15 @@ class Pipeline:
                                   lengths=cur_lengths)
             out, stats = fc.correct_batch(cur_batch, sr,
                                           detect_chimera=True)
+            if qc_on:
+                qr = stats.qc_rows
+                qc_rec.record_edits(qc_ids, qr["edits"], qr["uplift"])
+                qc_rec.record_finish(
+                    qc_ids, [len(o.record) for o in out], qr["admitted"],
+                    qr["support_sum"], cur_lengths)
+                for o in out:
+                    if o.chimera:
+                        qc_rec.record_chimera(o.record.id, o.chimera)
             frac_phred0 = float(np.mean([o.masked_frac for o in out])) \
                 if out else 0.0
             _record_report(reports, TaskReport(

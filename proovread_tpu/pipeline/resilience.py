@@ -329,7 +329,14 @@ class CheckpointJournal:
 
     # -- write ------------------------------------------------------------
     def put(self, key: str, bucket: int, results: Sequence, chim: Sequence,
-            reports: Sequence, sampler_first_chunk: int) -> None:
+            reports: Sequence, sampler_first_chunk: int,
+            qc_records: Optional[Sequence] = None) -> None:
+        """``qc_records``: the bucket's per-read QC provenance records
+        (obs/qc.py JSON-safe dicts), persisted so a ``--resume`` replay
+        reproduces the ``--qc-out`` artifact byte-identically. ``None``
+        (QC off) writes no ``qc`` key; a later QC-on resume then treats
+        the entry as a miss (``get(require_qc=True)``) rather than
+        replaying a bucket whose provenance was never recorded."""
         entry = {
             "key": key, "bucket": bucket,
             "sampler_first_chunk": int(sampler_first_chunk),
@@ -351,6 +358,8 @@ class CheckpointJournal:
                 "note": rep.note,
             } for rep in reports],
         }
+        if qc_records is not None:
+            entry["qc"] = list(qc_records)
         dst = os.path.join(self.path, f"bucket_{key}.json")
         with open(dst + ".tmp", "w") as fh:
             json.dump(entry, fh)
@@ -360,11 +369,19 @@ class CheckpointJournal:
                             unit="buckets").inc()
 
     # -- read -------------------------------------------------------------
-    def get(self, key: str):
-        """Returns (results, chim, reports, sampler_first_chunk) or None.
+    def get(self, key: str, require_qc: bool = False):
+        """Returns (results, chim, reports, sampler_first_chunk,
+        qc_records-or-None) or None. ``require_qc`` treats an entry
+        without stored QC records as a miss (checked BEFORE the hit is
+        counted, so a forced recompute never inflates the replay KPIs).
         Import of ConsensusResult is deferred: consensus.engine pulls jax."""
         e = self.entries.get(key)
         if e is None:
+            return None
+        if require_qc and e.get("qc") is None:
+            log.info("resume: journal entry for bucket %s has no QC "
+                     "records (written by a QC-off run) — recomputing",
+                     e.get("bucket"))
             return None
         from proovread_tpu.consensus.engine import ConsensusResult
         from proovread_tpu.pipeline.driver import TaskReport
@@ -388,4 +405,5 @@ class CheckpointJournal:
         self.hits += 1
         obs_metrics.counter("checkpoint_journal_replays",
                             unit="buckets").inc()
-        return results, chim, reports, e["sampler_first_chunk"]
+        return (results, chim, reports, e["sampler_first_chunk"],
+                e.get("qc"))

@@ -25,6 +25,7 @@ from proovread_tpu.align.mapper import JaxMapper
 from proovread_tpu.align.params import AlignParams
 from proovread_tpu.io.batch import pack_reads
 from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.obs import qc as obs_qc
 from proovread_tpu.ops.encode import decode_codes, encode_ascii, revcomp_codes
 
 
@@ -158,6 +159,8 @@ def siamaera_filter(
         if len(hsps) > 2 and drop_inconclusive:
             out[i] = None
             stats.dropped += 1
+            if (qrec := obs_qc.current()) is not None:
+                qrec.record_siamaera(r.id, "dropped")
             continue
         # junction estimate: HSP (qs,qe)~rc(ss,se) mirrors to read interval
         # (n-se, n-ss). Joined case: one HSP overlapping its own mirror,
@@ -187,5 +190,7 @@ def siamaera_filter(
             desc=(r.desc + " " if r.desc else "") + f"SIAMAERA:{a},{b - a}")
         out[i] = piece
         stats.trimmed += 1
+        if (qrec := obs_qc.current()) is not None:
+            qrec.record_siamaera(r.id, "trimmed", a, b - a)
 
     return [r for r in out if r is not None], stats

@@ -108,6 +108,17 @@ def _pipeline_config(cfg: Config, mode: str, tasks: Sequence[str],
     )
 
 
+def _embed_qc(result: PipelineResult) -> None:
+    """(Re-)embed the aggregate QC report + gauges: the trim funnel and
+    siamaera hits land after Pipeline.run already aggregated once, so
+    every run_tasks return path refreshes the embedded report (gauge
+    publication is idempotent)."""
+    rec = obs.qc.current()
+    if rec is not None:
+        result.qc = rec.aggregate()
+        rec.to_metrics(result.qc)
+
+
 def _apply_siamaera(cfg: Config, result: PipelineResult) -> None:
     """Final-output siamaera pass over the trimmed records
     (bin/proovread:923-933); ``"siamaera": null`` in the config
@@ -222,6 +233,7 @@ def run_tasks(
                 trimmed=trim_records(results, _trim_params(cfg)),
                 ignored=ignored0, chimera=chim, reports=reports)
             _apply_siamaera(cfg, result)
+            _embed_qc(result)
             result.metrics = reg.as_dict()
         return result
 
@@ -265,6 +277,7 @@ def run_tasks(
         result.reports = reports + result.reports
         result.ignored = ignored0 + result.ignored
         _apply_siamaera(cfg, result)
+        _embed_qc(result)
         return result
 
     # -- iterated short-read correction ----------------------------------
@@ -280,6 +293,7 @@ def run_tasks(
         result.reports = reports + result.reports
         result.ignored = ignored0 + result.ignored
         _apply_siamaera(cfg, result)
+        _embed_qc(result)
         return result
 
     if utg_corrected:
@@ -301,6 +315,7 @@ def run_tasks(
                 untrimmed=longs, trimmed=trimmed,
                 ignored=ignored0, chimera=[], reports=reports)
             _apply_siamaera(cfg, result)
+            _embed_qc(result)
             result.metrics = reg.as_dict()
         return result
 

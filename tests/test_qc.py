@@ -1,0 +1,490 @@
+"""Correction-quality observability tests (obs/qc.py): recorder units,
+the strict per-record schema + the schema-drift lint guard, QC parity
+across the fused / eager / host-scan ladder rungs and a --resume replay,
+the CLI --qc-out artifact, and the zero-overhead guard for the QC-off
+path (docs/OBSERVABILITY.md "Correction QC")."""
+
+import json
+
+import numpy as np
+import pytest
+
+from proovread_tpu.obs import qc as obs_qc
+from proovread_tpu.obs import validate as obs_validate
+from proovread_tpu.obs.validate import (QC_RECORD_FIELDS, ValidationError,
+                                        validate_qc, validate_qc_record)
+
+
+class _FakeRead:
+    def __init__(self, rid, n):
+        self.id = rid
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+
+def _drive_all_writer_paths(rec: obs_qc.QcRecorder) -> None:
+    """Touch EVERY record_* writer path once, so the resulting records
+    exercise every field the writer can emit."""
+    rec.record_ccs("a", "primary", 3)          # pre-bucket (lazy record)
+    rec.start_bucket(0, [_FakeRead("a", 100), _FakeRead("b", 200)],
+                     span_id=7)
+    rec.record_pass(["a", "b"], [10, 20], [100, 200])
+    rec.record_pass(["a", "b"], [30, 40], [101, 199])
+    rec.record_edits(["a", "b"], [5, 6], [1, 2])
+    rec.record_finish(["a", "b"], [99, 198], [3, 4],
+                      [300.0, 800.0], [100, 200])
+    rec.record_chimera("a", [(5, 9, 0.5)])
+    rec.record_siamaera("a.1", "trimmed", 0, 50)   # split-piece id resolves
+    rec.record_siamaera("b", "dropped")
+    rec.record_trim("a", 2, 40, 10, 1, 49)
+
+
+# --------------------------------------------------------------------------
+# recorder units
+# --------------------------------------------------------------------------
+
+class TestRecorder:
+    def test_record_lifecycle_and_fields(self):
+        rec = obs_qc.QcRecorder()
+        _drive_all_writer_paths(rec)
+        a = rec.records["a"]
+        assert a["bucket"] == 0 and a["bucket_span"] == 7
+        assert a["in_len"] == 100 and a["out_len"] == 99
+        assert a["masked_frac"] == [round(10 / 100, 9), round(30 / 101, 9)]
+        assert a["n_iterations"] == 2
+        assert a["finish_admitted"] == 3
+        assert a["mean_support"] == pytest.approx(3.0)
+        assert a["corrected_bases"] == 5 and a["phred_uplift"] == 1
+        assert a["chimera"] == [[5, 9, 0.5]]
+        # the ".1" split-piece suffix resolved back to the parent read
+        assert a["siamaera"] == {"action": "trimmed", "start": 0,
+                                 "len": 50}
+        assert "a.1" not in rec.records
+        assert a["ccs"] == {"role": "primary", "n_subreads": 3}
+        assert a["trim"]["pieces"] == 2 and a["trim"]["bases_out"] == 49
+
+    def test_snapshot_restore_rewinds_attempt(self):
+        """Ladder-demotion rollback: a failed attempt's partial
+        trajectory must rewind exactly (driver rewinds reports/KPIs and
+        QC together)."""
+        rec = obs_qc.QcRecorder()
+        rec.start_bucket(0, [_FakeRead("a", 100)])
+        snap = rec.snapshot(["a"])
+        rec.record_pass(["a"], [50], [100])
+        rec.record_edits(["a"], [9], [9])
+        rec.restore(["a"], snap)
+        assert rec.records["a"]["masked_frac"] == []
+        assert rec.records["a"]["corrected_bases"] == 0
+        # snapshot of a read never seen -> restore removes it
+        snap2 = rec.snapshot(["ghost"])
+        rec.start_bucket(1, [_FakeRead("ghost", 10)])
+        rec.restore(["ghost"], snap2)
+        assert "ghost" not in rec.records
+
+    def test_splice_rebinds_bucket_span(self):
+        rec = obs_qc.QcRecorder()
+        rec.start_bucket(0, [_FakeRead("a", 100)], span_id=3)
+        payload = rec.bucket_payload(["a"])
+        rec2 = obs_qc.QcRecorder()
+        rec2.splice(payload, span_id=11)
+        assert rec2.records["a"]["bucket_span"] == 11
+        rec2.splice(payload, span_id=None)
+        assert rec2.records["a"]["bucket_span"] is None
+
+    def test_scope_and_install(self):
+        assert obs_qc.current() is None and not obs_qc.enabled()
+        with obs_qc.scope() as rec:
+            assert obs_qc.current() is rec and obs_qc.enabled()
+            with obs_qc.scope() as inner:
+                assert inner is rec
+        assert obs_qc.current() is None
+
+    def test_funnel_keys_match_aggregate(self):
+        rec = obs_qc.QcRecorder()
+        _drive_all_writer_paths(rec)
+        agg = rec.aggregate()
+        assert set(agg["funnel"]) == set(obs_qc.FUNNEL_KEYS)
+        assert agg["n_reads"] == 2
+        h = agg["histograms"]["masked_frac_final"]
+        assert sum(h["counts"]) == 2 and len(h["edges"]) == 11
+        assert rec.report_lines()
+
+
+# --------------------------------------------------------------------------
+# schema: strict validation + the drift lint guard
+# --------------------------------------------------------------------------
+
+class TestQcSchema:
+    def test_schema_never_drifts(self, tmp_path):
+        """Lint guard (mirrors test_no_naked_timers): drive every writer
+        path, then strictly validate — a field the writer emits that is
+        not declared in obs/validate.py:QC_RECORD_FIELDS fails, and a
+        declared field the writer stops emitting fails. The declaration
+        lives in validate.py on purpose, so writer changes cannot
+        auto-update the schema."""
+        rec = obs_qc.QcRecorder()
+        _drive_all_writer_paths(rec)
+        for r in rec.iter_records():
+            validate_qc_record(r)
+            assert set(r) == set(QC_RECORD_FIELDS)
+        # the artifact as a whole round-trips through the strict validator
+        p = str(tmp_path / "qc.jsonl")
+        rec.write_jsonl(p)
+        stats = validate_qc(p, min_reads=2)
+        assert stats["n_records"] == 2 and stats["n_chimeric"] == 1
+        # the empty-record template is schema-complete too
+        validate_qc_record(obs_qc.new_record("x"))
+        assert set(obs_qc.new_record("x")) == set(QC_RECORD_FIELDS)
+
+    def test_undeclared_field_fails(self):
+        r = obs_qc.new_record("x")
+        r["sneaky_new_field"] = 1
+        with pytest.raises(ValidationError, match="undeclared"):
+            validate_qc_record(r)
+
+    def test_missing_field_fails(self):
+        r = obs_qc.new_record("x")
+        del r["mean_support"]
+        with pytest.raises(ValidationError, match="missing required"):
+            validate_qc_record(r)
+
+    def test_type_and_invariant_failures(self):
+        r = obs_qc.new_record("x")
+        r["out_len"] = "nope"
+        with pytest.raises(ValidationError, match="type"):
+            validate_qc_record(r)
+        r = obs_qc.new_record("x")
+        r["masked_frac"] = [1.5]
+        with pytest.raises(ValidationError, match="not in"):
+            validate_qc_record(r)
+        r = obs_qc.new_record("x")
+        r["n_iterations"] = 2
+        with pytest.raises(ValidationError, match="trajectory"):
+            validate_qc_record(r)
+
+    def test_validate_qc_file_level(self, tmp_path):
+        p = tmp_path / "qc.jsonl"
+        # no meta line
+        p.write_text(json.dumps(obs_qc.new_record("a")) + "\n")
+        with pytest.raises(ValidationError, match="meta"):
+            validate_qc(str(p))
+        # meta count mismatch
+        p.write_text(json.dumps({"qc_schema": 1, "n_reads": 2,
+                                 "aggregate": {}}) + "\n"
+                     + json.dumps(obs_qc.new_record("a")) + "\n")
+        with pytest.raises(ValidationError, match="n_reads"):
+            validate_qc(str(p))
+        # duplicate ids
+        p.write_text(json.dumps({"qc_schema": 1, "n_reads": 2,
+                                 "aggregate": {}}) + "\n"
+                     + json.dumps(obs_qc.new_record("a")) + "\n"
+                     + json.dumps(obs_qc.new_record("a")) + "\n")
+        with pytest.raises(ValidationError, match="duplicate"):
+            validate_qc(str(p))
+
+    def test_validate_cli_accepts_qc(self, tmp_path, capsys):
+        rec = obs_qc.QcRecorder()
+        _drive_all_writer_paths(rec)
+        p = str(tmp_path / "qc.jsonl")
+        rec.write_jsonl(p)
+        assert obs_validate.main(["--qc", p, "--min-qc-reads", "2"]) == 0
+        assert "qc OK" in capsys.readouterr().out
+        assert obs_validate.main(["--qc", p, "--min-qc-reads", "99"]) == 1
+
+
+# --------------------------------------------------------------------------
+# trim/siamaera funnel recording units (host-only, tier-1 fast)
+# --------------------------------------------------------------------------
+
+class TestFunnelRecording:
+    def test_trim_records_funnel(self):
+        from proovread_tpu.consensus.engine import ConsensusResult
+        from proovread_tpu.io.records import SeqRecord
+        from proovread_tpu.pipeline.trim import TrimParams, trim_records
+
+        e = np.zeros(0, np.float32)
+        n = 1200
+        qual = np.full(n, 30, np.uint8)
+        res = ConsensusResult(
+            record=SeqRecord("r", "A" * n, qual=qual),
+            freqs=e, coverage=e, cigar="",
+            chimera=[(600, 610, 0.9)])
+        p = TrimParams(min_length=100)
+        with obs_qc.scope() as rec:
+            out = trim_records([res], p)
+        t = rec.records["r"]["trim"]
+        assert t["pieces"] == 2
+        # split at (600, 610) with trim-length 20: both margins lost
+        assert t["chimera_bases_lost"] == n - sum(len(r) for r in out) \
+            - t["trim_bases_lost"]
+        assert t["bases_out"] == sum(len(r) for r in out)
+        assert t["pieces_dropped"] == 0
+
+    def test_trim_records_drop_counts_whole_piece(self):
+        from proovread_tpu.consensus.engine import ConsensusResult
+        from proovread_tpu.io.records import SeqRecord
+        from proovread_tpu.pipeline.trim import TrimParams, trim_records
+
+        e = np.zeros(0, np.float32)
+        res = ConsensusResult(
+            record=SeqRecord("r", "A" * 80,
+                             qual=np.zeros(80, np.uint8)),
+            freqs=e, coverage=e, cigar="")
+        with obs_qc.scope() as rec:
+            out = trim_records([res], TrimParams(min_length=100))
+        assert out == []
+        t = rec.records["r"]["trim"]
+        assert t["pieces_dropped"] == 1
+        assert t["trim_bases_lost"] == 80 and t["bases_out"] == 0
+
+
+# --------------------------------------------------------------------------
+# zero-overhead guard: with no recorder installed, NO QC machinery runs —
+# not the host bookkeeping, not the per-row device reductions
+# --------------------------------------------------------------------------
+
+def test_qc_zero_overhead_when_off(monkeypatch):
+    """Tier-1 twin of PR 4's test_zero_overhead_unprofiled_path: a QC-off
+    pipeline run must never touch the recorder methods or the device-side
+    QC reductions (dcorrect.qc_*) — the --qc-out-off path stays
+    byte-identical to the pre-QC pipeline."""
+    from proovread_tpu.io.records import SeqRecord
+    from proovread_tpu.ops.encode import decode_codes
+    from proovread_tpu.pipeline import (Pipeline, PipelineConfig,
+                                        TrimParams)
+    from proovread_tpu.pipeline import dcorrect
+
+    def _boom(*a, **k):                                 # noqa: ANN001
+        raise AssertionError("QC machinery ran while disabled")
+
+    for name in ("start_bucket", "record_pass", "record_edits",
+                 "record_finish", "record_chimera", "record_siamaera",
+                 "record_trim", "record_ccs", "snapshot", "restore",
+                 "bucket_payload", "splice"):
+        monkeypatch.setattr(obs_qc.QcRecorder, name, _boom)
+    for name in ("qc_row_mask_counts", "qc_pass_row_stats",
+                 "qc_finish_support"):
+        monkeypatch.setattr(dcorrect, name, _boom)
+
+    assert obs_qc.current() is None
+    rng = np.random.default_rng(11)
+    genome = rng.integers(0, 4, 400).astype(np.int8)
+    longs = [SeqRecord(f"r{i}", decode_codes(genome[s:s + 200]))
+             for i, s in enumerate((0, 100))]
+    srs = [SeqRecord(f"s{i}", decode_codes(genome[s:s + 100]),
+                     qual=np.full(100, 30, np.uint8))
+           for i, s in enumerate(rng.integers(0, 300, 30))]
+    res = Pipeline(PipelineConfig(
+        mode="sr", n_iterations=1, sampling=False, engine="scan",
+        batch_reads=8, trim=TrimParams(min_length=100))).run(longs, srs)
+    assert len(res.untrimmed) == 2
+    assert res.qc is None
+
+
+# --------------------------------------------------------------------------
+# end-to-end parity: fused vs eager vs host-scan rungs, --resume replay
+# (device engine, interpret-mode Pallas)
+# --------------------------------------------------------------------------
+
+def _uniform_dataset(rng, G=600, n_long=6, read_len=300, n_sr=45,
+                     lr_err=0.08):
+    """Uniform lengths so the device bucketing and the scan engine's
+    batching produce identical partitions (same construction as
+    tests/test_resilience.py's ladder-parity dataset)."""
+    from proovread_tpu.io.records import SeqRecord
+    from proovread_tpu.ops.encode import decode_codes, revcomp_codes
+    genome = rng.integers(0, 4, G).astype(np.int8)
+    longs = []
+    for i in range(n_long):
+        a = int(rng.integers(0, G - read_len))
+        src = genome[a:a + read_len]
+        noisy = []
+        for base in src:
+            u = rng.random()
+            if u < lr_err * 0.5:
+                noisy.append(int(rng.integers(0, 4)))
+                noisy.append(int(base))
+            elif u < lr_err * 0.75:
+                continue
+            elif u < lr_err:
+                noisy.append(int((base + 1) % 4))
+            else:
+                noisy.append(int(base))
+        longs.append(SeqRecord(f"r{i}",
+                               decode_codes(np.array(noisy, np.int8))))
+    srs = []
+    for i in range(n_sr):
+        st = int(rng.integers(0, G - 100))
+        seq = genome[st:st + 100].copy()
+        if rng.random() < 0.5:
+            seq = revcomp_codes(seq)
+        srs.append(SeqRecord(f"s{i}", decode_codes(seq),
+                             qual=np.full(100, 30, np.uint8)))
+    return longs, srs
+
+
+def _qc_run(longs, srs, engine="device", **kw):
+    from proovread_tpu.pipeline import (Pipeline, PipelineConfig,
+                                        TrimParams)
+    cfg = dict(mode="sr", n_iterations=2, sampling=False, engine=engine,
+               device_chunk=128, batch_reads=8, host_chunk_rows=512,
+               trim=TrimParams(min_length=150))
+    cfg.update(kw)
+    with obs_qc.scope() as rec:
+        res = Pipeline(PipelineConfig(**cfg)).run(longs, srs)
+        for r in rec.iter_records():
+            validate_qc_record(r)
+        return {r["id"]: r for r in rec.iter_records()}, res
+
+
+def _assert_records_identical(qa, qb, what):
+    assert set(qa) == set(qb), what
+    for rid in qa:
+        for k in qa[rid]:
+            assert qa[rid][k] == qb[rid][k], (
+                f"{what}: read {rid} field {k}: "
+                f"{qa[rid][k]!r} != {qb[rid][k]!r}")
+
+
+@pytest.mark.heavy
+class TestQcRungParity:
+    """Acceptance: per-read QC records are identical whichever ladder
+    rung computed the bucket, and across a --resume replay. Integer
+    fields compare bitwise; the float fields (masked_frac, mean_support)
+    are derived on the host from integer-exact device sums, so they too
+    compare exactly."""
+
+    def test_fused_vs_eager_rung(self):
+        rng = np.random.default_rng(41)
+        longs, srs = _uniform_dataset(rng)
+        q_fused, _ = _qc_run(longs, srs)
+        # one injected compile fault demotes bucket 0's fused program;
+        # the retry runs the SAME passes eagerly
+        q_eager, res = _qc_run(longs, srs,
+                               fault_spec="compile@b0.p2x1")
+        assert any(r.task.startswith("demote-") for r in res.reports)
+        _assert_records_identical(q_fused, q_eager, "fused vs eager")
+
+    def test_host_scan_rung_matches_scan_engine(self):
+        """A bucket demoted all the way to the host-scan rung emits the
+        records an engine='scan' run would (same twin formulas over the
+        same pileups) — and the demotion rollback wiped the failed
+        attempts' partial trajectories."""
+        rng = np.random.default_rng(41)
+        longs, srs = _uniform_dataset(rng)
+        q_host, res = _qc_run(longs, srs, fault_spec="compile@b0")
+        rungs = [r.note for r in res.reports if r.task.startswith("demote")]
+        assert any("host-scan" in n for n in rungs)
+        q_scan, _ = _qc_run(longs, srs, engine="scan")
+        _assert_records_identical(q_host, q_scan,
+                                  "host-scan rung vs scan engine")
+
+    def test_resume_replay_identical(self, tmp_path):
+        rng = np.random.default_rng(43)
+        longs, srs = _uniform_dataset(rng)
+        ck = str(tmp_path / "ckpt")
+        q1, _ = _qc_run(longs, srs, checkpoint_dir=ck)
+        q2, res2 = _qc_run(longs, srs, checkpoint_dir=ck, resume=True)
+        replays = sum(
+            s["value"] for s in res2.metrics["counters"]
+            ["checkpoint_journal_replays"]["series"])
+        assert replays >= 1
+        _assert_records_identical(q1, q2, "resume replay")
+
+    def test_qc_off_journal_entry_recomputes_under_qc(self, tmp_path):
+        """A journal written by a QC-off run must not satisfy a QC-on
+        resume: the bucket recomputes (identical output) and the QC
+        records exist."""
+        from proovread_tpu.pipeline import (Pipeline, PipelineConfig,
+                                            TrimParams)
+        rng = np.random.default_rng(47)
+        longs, srs = _uniform_dataset(rng, n_long=4)
+        ck = str(tmp_path / "ckpt")
+        cfg = dict(mode="sr", n_iterations=1, sampling=False,
+                   engine="device", device_chunk=128, batch_reads=8,
+                   trim=TrimParams(min_length=150), checkpoint_dir=ck)
+        Pipeline(PipelineConfig(**cfg)).run(longs, srs)     # QC off
+        q2, res2 = _qc_run(longs, srs, n_iterations=1,
+                           checkpoint_dir=ck, resume=True)
+        replays = sum(
+            s["value"] for s in res2.metrics["counters"]
+            ["checkpoint_journal_replays"]["series"])
+        assert replays == 0                 # entry treated as a miss
+        assert len(q2) == len(longs)
+        assert all(r["out_len"] > 0 for r in q2.values())
+
+
+# --------------------------------------------------------------------------
+# result embedding + metrics gauges + CLI artifact
+# --------------------------------------------------------------------------
+
+@pytest.mark.heavy
+class TestQcEndToEnd:
+    def test_result_embeds_aggregate_and_gauges(self):
+        from proovread_tpu.obs import metrics as obsm
+        rng = np.random.default_rng(53)
+        longs, srs = _uniform_dataset(rng, n_long=4)
+        from proovread_tpu.pipeline import (Pipeline, PipelineConfig,
+                                            TrimParams)
+        with obs_qc.scope(), obsm.scope() as reg:
+            res = Pipeline(PipelineConfig(
+                mode="sr", n_iterations=1, sampling=False,
+                engine="device", device_chunk=128, batch_reads=8,
+                trim=TrimParams(min_length=150))).run(longs, srs)
+        assert res.qc is not None
+        assert res.qc["n_reads"] == len(longs)
+        assert res.qc["funnel"]["reads_corrected"] == len(longs)
+        assert reg.gauge("qc_reads").value() == len(longs)
+        assert res.metrics["gauges"]["qc_reads"]["series"][0]["value"] \
+            == len(longs)
+
+    def test_cli_qc_out_artifact(self, tmp_path):
+        """proovread --qc-out on a small dataset produces a schema-valid
+        artifact whose records link to bucket span ids present in the
+        --trace artifact."""
+        from proovread_tpu.cli import main as cli_main
+        from proovread_tpu.io.fastq import FastqWriter
+
+        rng = np.random.default_rng(59)
+        longs, srs = _uniform_dataset(rng, n_long=4)
+
+        def w(path, records):
+            with open(path, "wb") as fh:
+                wr = FastqWriter(fh)
+                for r in records:
+                    if r.qual is None:
+                        r = type(r)(id=r.id, seq=r.seq,
+                                    qual=np.full(len(r), 30, np.uint8))
+                    wr.write(r)
+
+        lp = str(tmp_path / "l.fq")
+        sp = str(tmp_path / "s.fq")
+        w(lp, longs)
+        w(sp, srs)
+        cfgp = str(tmp_path / "c.cfg")
+        with open(cfgp, "w") as fh:
+            json.dump({"batch-reads": 8, "device-chunk": 128,
+                       "seq-filter": {"--min-length": 150}}, fh)
+        out = str(tmp_path / "out")
+        qcp = str(tmp_path / "run.qc.jsonl")
+        tp = str(tmp_path / "run.trace.jsonl")
+        rc = cli_main(["-l", lp, "-s", sp, "-p", out, "-m", "sr-noccs",
+                       "-c", cfgp, "--qc-out", qcp, "--trace", tp])
+        assert rc == 0
+        stats = validate_qc(qcp, min_reads=len(longs))
+        assert stats["n_records"] == len(longs)
+        # every record's bucket_span resolves into the trace
+        bucket_spans = set()
+        with open(tp) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                if ev.get("ph") == "X" and ev.get("cat") == "bucket":
+                    bucket_spans.add(ev["args"]["span_id"])
+        with open(qcp) as fh:
+            next(fh)
+            for line in fh:
+                r = json.loads(line)
+                assert r["bucket_span"] in bucket_spans, r["id"]
+                assert r["out_len"] > 0 and r["masked_frac"]

@@ -146,8 +146,11 @@ class TestJournal:
         j2 = CheckpointJournal(str(tmp_path / "ckpt"), "fp1", resume=True)
         hit = j2.get("k1")
         assert hit is not None
-        results, chim, reports, fc = hit
+        results, chim, reports, fc, qc_payload = hit
         assert fc == 7
+        assert qc_payload is None            # written without QC records
+        # a QC-on resume must treat that entry as a miss, uncounted
+        assert j2.get("k1", require_qc=True) is None
         assert chim == [("a", 1, 2, 0.5)]
         assert [r.record.id for r in results] == ["a", "b"]
         assert results[0].record.seq == "ACGT"
