@@ -10,7 +10,7 @@
 #   make perf-report  PERF.md-style phase/kernel tables from that history
 #   make bench        the benchmark itself (one JSON row on stdout)
 
-.PHONY: smoke test test-all test-faults trace-smoke qc-smoke perf-check perf-report bench
+.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke perf-check perf-report bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -49,6 +49,16 @@ trace-smoke:
 # trajectory (docs/OBSERVABILITY.md "Correction QC")
 qc-smoke:
 	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.smoke --qc-only
+
+# serving tier (docs/SERVING.md): boot the correction server on CPU, run
+# the deterministic mixed-traffic stream (CLR + CCS + unitig jobs, two
+# tenants) with one injected fault per job-level class (parse / quota /
+# deadline / worker death / journal corruption), drain mid-wave on
+# SIGTERM, restart with resume — assert a clean drain, every job
+# terminal with an attributable status (nothing silently lost), a
+# strictly schema-valid SLO artifact, and no live-array leak
+serve-smoke:
+	JAX_PLATFORMS=cpu python -m proovread_tpu.serve.smoke
 
 # perf-regression gate (docs/OBSERVABILITY.md): newest usable BENCH row vs
 # a rolling baseline — headline bases/sec, wall, and per-phase deltas.

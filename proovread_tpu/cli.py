@@ -194,6 +194,13 @@ def _setup_logging(args) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "serve":
+        # the serving layer loads lazily, HERE and only here: the batch
+        # path imports nothing from proovread_tpu.serve (tier-1 guard
+        # tests/test_serve.py::test_batch_cli_never_imports_serve)
+        from proovread_tpu.serve.cli import serve_main
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     _setup_logging(args)
 

@@ -12,6 +12,10 @@ metrics JSON against the ``obs.metrics`` schema.
 against the ``QC_RECORD_FIELDS`` schema (undeclared fields fail — the
 writer can never silently drift, tests/test_qc.py).
 
+``validate_slo`` strictly checks the serving SLO artifact
+(``proovread-tpu serve --slo-out``, docs/SERVING.md) — schema plus the
+no-job-silently-lost accounting identity.
+
 All are importable (``make trace-smoke`` / ``make qc-smoke``, tests) and
 runnable::
 
@@ -65,6 +69,25 @@ QC_CCS_FIELDS = {"role": (str,), "n_subreads": (int,)}
 QC_TRIM_FIELDS = {"pieces": (int,), "chimera_bases_lost": (int,),
                   "trim_bases_lost": (int,), "pieces_dropped": (int,),
                   "bases_out": (int,)}
+
+
+# -- serving SLO artifact schema (serve/server.py writer) ------------------
+# Same declaration discipline as the QC schema: declared here,
+# independently of the writer, and validated STRICTLY (undeclared fields
+# fail) so the serving layer can never silently drift its SLO contract.
+SLO_SCHEMA_VERSION = 1
+_BOOL = (bool,)
+SLO_JOB_KEYS = ("accepted", "rejected", "journaled", "completed",
+                "failed", "cancelled", "expired")
+SLO_TOP_FIELDS = ("slo_schema", "jobs", "rejections", "queue", "latency",
+                  "demotions", "drain")
+SLO_LATENCY_KEYS = ("count", "p50_s", "p99_s", "max_s")
+SLO_QUEUE_KEYS = ("depth_peak", "depth_final")
+SLO_DRAIN_KEYS = ("requested", "clean")
+# closed rejection vocabulary (serve/admission.py REJECT_REASONS)
+SLO_REJECT_REASONS = ("quota-jobs", "quota-bases", "queue-full",
+                      "parse-error", "bad-request", "duplicate-job",
+                      "draining")
 
 
 class ValidationError(ValueError):
@@ -306,6 +329,100 @@ def validate_qc(path: str, min_reads: int = 0) -> Dict[str, Any]:
             "aggregate": meta["aggregate"]}
 
 
+def validate_slo(path: str, require_drained: bool = False
+                 ) -> Dict[str, Any]:
+    """Strictly validate a serving SLO artifact (``serve --slo-out``):
+    every declared section present and typed, no undeclared fields, the
+    rejection reasons within the closed vocabulary, and — the acceptance
+    bar — the job-accounting identity
+
+        accepted == completed + failed + cancelled + expired + journaled
+
+    i.e. *no job is silently lost*: every admitted job either reached a
+    terminal state or is journaled for resume. ``require_drained``
+    additionally demands a clean drain with nothing left journaled."""
+    with open(path) as fh:
+        try:
+            d = json.load(fh)
+        except json.JSONDecodeError as e:
+            _fail(f"{path}: not JSON ({e})")
+    if not isinstance(d, dict) or d.get("slo_schema") != SLO_SCHEMA_VERSION:
+        _fail(f"{path}: slo_schema != {SLO_SCHEMA_VERSION}")
+    unknown = [k for k in d if k not in SLO_TOP_FIELDS]
+    missing = [k for k in SLO_TOP_FIELDS if k not in d]
+    if unknown or missing:
+        _fail(f"{path}: undeclared fields {unknown} / missing {missing} "
+              "— declare in obs/validate.py:SLO_TOP_FIELDS first")
+    jobs = d["jobs"]
+    if not isinstance(jobs, dict) or \
+            sorted(jobs) != sorted(SLO_JOB_KEYS):
+        _fail(f"{path}: jobs must have exactly keys {SLO_JOB_KEYS}")
+    for k, v in jobs.items():
+        if not isinstance(v, int) or v < 0:
+            _fail(f"{path}: jobs.{k} must be a >=0 int")
+    accounted = sum(jobs[k] for k in ("completed", "failed", "cancelled",
+                                      "expired", "journaled"))
+    if jobs["accepted"] != accounted:
+        _fail(f"{path}: job accounting broken — accepted "
+              f"{jobs['accepted']} != completed+failed+cancelled+expired"
+              f"+journaled {accounted} (a job was silently lost)")
+    rej = d["rejections"]
+    if not isinstance(rej, dict):
+        _fail(f"{path}: rejections must be an object")
+    bad = [k for k in rej if k not in SLO_REJECT_REASONS]
+    if bad:
+        _fail(f"{path}: rejection reasons {bad} outside the closed "
+              f"vocabulary {SLO_REJECT_REASONS}")
+    for k, v in rej.items():
+        if not isinstance(v, int) or v < 0:
+            _fail(f"{path}: rejections.{k} must be a >=0 int")
+    if sum(rej.values()) != jobs["rejected"]:
+        _fail(f"{path}: jobs.rejected {jobs['rejected']} != sum of "
+              f"per-reason rejections {sum(rej.values())}")
+    q = d["queue"]
+    if not isinstance(q, dict) or sorted(q) != sorted(SLO_QUEUE_KEYS):
+        _fail(f"{path}: queue must have exactly keys {SLO_QUEUE_KEYS}")
+    for k in SLO_QUEUE_KEYS:
+        if not isinstance(q[k], int) or q[k] < 0:
+            _fail(f"{path}: queue.{k} must be a >=0 int")
+    lat = d["latency"]
+    if not isinstance(lat, dict):
+        _fail(f"{path}: latency must be an object")
+    for cls, row in lat.items():
+        if not isinstance(row, dict) or \
+                sorted(row) != sorted(SLO_LATENCY_KEYS):
+            _fail(f"{path}: latency[{cls!r}] must have exactly keys "
+                  f"{SLO_LATENCY_KEYS}")
+        if not isinstance(row["count"], int) or row["count"] <= 0:
+            _fail(f"{path}: latency[{cls!r}].count must be a positive "
+                  "int")
+        for k in ("p50_s", "p99_s", "max_s"):
+            if not isinstance(row[k], _NUM) or row[k] < 0:
+                _fail(f"{path}: latency[{cls!r}].{k} must be a >=0 "
+                      "number")
+        if not row["p50_s"] <= row["p99_s"] <= row["max_s"]:
+            _fail(f"{path}: latency[{cls!r}] percentiles not monotonic")
+    dem = d["demotions"]
+    if not isinstance(dem, dict) or any(
+            not isinstance(v, int) or v < 0 for v in dem.values()):
+        _fail(f"{path}: demotions must map tenant -> >=0 int")
+    drain = d["drain"]
+    if not isinstance(drain, dict) or \
+            sorted(drain) != sorted(SLO_DRAIN_KEYS):
+        _fail(f"{path}: drain must have exactly keys {SLO_DRAIN_KEYS}")
+    for k in SLO_DRAIN_KEYS:
+        if not isinstance(drain[k], bool):
+            _fail(f"{path}: drain.{k} must be a bool")
+    if require_drained:
+        if not drain["clean"]:
+            _fail(f"{path}: drain was not clean")
+        if jobs["journaled"] and not drain["requested"]:
+            _fail(f"{path}: {jobs['journaled']} job(s) journaled without "
+                  "a requested drain")
+    return {"jobs": jobs, "n_latency_classes": len(lat),
+            "rejections": sum(rej.values())}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="proovread-tpu-obs-validate",
@@ -313,6 +430,9 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", help="trace-event JSONL file")
     ap.add_argument("--metrics", help="metrics JSON file")
     ap.add_argument("--qc", help="per-read QC JSONL file (--qc-out)")
+    ap.add_argument("--slo", help="serving SLO artifact (serve --slo-out)")
+    ap.add_argument("--require-drained", action="store_true",
+                    help="SLO artifact must show a clean drain")
     ap.add_argument("--min-qc-reads", type=int, default=0,
                     help="minimum per-read QC record count")
     ap.add_argument("--min-coverage", type=float, default=0.0,
@@ -323,8 +443,8 @@ def main(argv=None) -> int:
     ap.add_argument("--require", default="",
                     help="comma-separated counter names that must exist")
     args = ap.parse_args(argv)
-    if not (args.trace or args.metrics or args.qc):
-        ap.error("need --trace, --metrics and/or --qc")
+    if not (args.trace or args.metrics or args.qc or args.slo):
+        ap.error("need --trace, --metrics, --qc and/or --slo")
     try:
         if args.trace:
             stats = validate_trace(
@@ -339,6 +459,10 @@ def main(argv=None) -> int:
         if args.qc:
             stats = validate_qc(args.qc, min_reads=args.min_qc_reads)
             print(f"qc OK: {json.dumps({k: v for k, v in stats.items() if k != 'aggregate'})}")
+        if args.slo:
+            stats = validate_slo(args.slo,
+                                 require_drained=args.require_drained)
+            print(f"slo OK: {json.dumps(stats)}")
     except ValidationError as e:
         print(f"validation FAILED: {e}", file=sys.stderr)
         return 1

@@ -329,6 +329,43 @@ class _SrDevice:
 class Pipeline:
     def __init__(self, config: Optional[PipelineConfig] = None):
         self.config = config or PipelineConfig()
+        # -- serving hooks (proovread_tpu/serve, docs/SERVING.md) ---------
+        # _bucket_gate(gi, n_groups, batch_recs) -> records: called before
+        # each bucket computes; may filter the bucket's records (dropping
+        # a cancelled/deadline-breached job's reads), return [] to skip
+        # the bucket, or raise to stop the run at a bucket boundary
+        # (graceful drain). _bucket_done(gi, results, chim, replayed) is
+        # called after each bucket's results are in — the continuous
+        # batcher finalizes any job whose reads are all corrected without
+        # waiting for the rest of the wave. Both None on the batch path.
+        self._bucket_gate = None
+        self._bucket_done = None
+
+    def prepare_short_reads(self, short_records: Sequence[SeqRecord]
+                            ) -> None:
+        """Pack — and for the device engine, device-stage — the short-read
+        set ONCE for repeated :meth:`run` calls over the same list object
+        (the serving hot path: ``serve/`` keeps one corrector process hot
+        across jobs, so re-packing and re-uploading the SR set every wave
+        is pure waste). Cached by list identity; ``run`` falls back to
+        per-call packing when given a different set."""
+        cfg = self.config
+        pm = 16 if cfg.engine == "device" else 128
+        sr_all = pack_reads(short_records, pad_multiple=pm)
+        sr_dev = (self._make_sr_device(sr_all)
+                  if cfg.engine == "device" else None)
+        self._sr_prep = (short_records, pm, sr_all, sr_dev)
+
+    def _make_sr_device(self, sr_all: ReadBatch) -> "_SrDevice":
+        cfg = self.config
+        sr_bytes = 3 * sr_all.codes.nbytes + sr_all.lengths.nbytes
+        resident = sr_bytes <= cfg.sr_device_budget
+        if not resident:
+            log.info(
+                "short-read set %.1f GB exceeds sr-device-budget "
+                "%.1f GB: streaming slab regime (per-pass upload)",
+                sr_bytes / 2**30, cfg.sr_device_budget / 2**30)
+        return _SrDevice(sr_all, resident=resident)
 
     # -- read-long (bin/proovread:1368-1520) ------------------------------
     def read_long(self, records: Sequence[SeqRecord], min_sr_len: int
@@ -398,9 +435,13 @@ class Pipeline:
         # would waste 28% of the forward pass
         # 16 keeps n = m + W a multiple of 16, which keeps the pileup
         # kernel's window offsets on bf16 (16, 128) tile boundaries
-        sr_all = pack_reads(short_records,
-                            pad_multiple=16 if cfg.engine == "device"
-                            else 128)
+        pm = 16 if cfg.engine == "device" else 128
+        prep = getattr(self, "_sr_prep", None)
+        if prep is not None and prep[0] is short_records and prep[1] == pm:
+            sr_all = prep[2]            # prepare_short_reads hot path
+        else:
+            prep = None
+            sr_all = pack_reads(short_records, pad_multiple=pm)
 
         untrimmed: List[SeqRecord] = []
         results_final: List[ConsensusResult] = []
@@ -459,24 +500,29 @@ class Pipeline:
             log.info("resume: %s", note)
             return res_batch, chim
 
+        gate = self._bucket_gate
+        done_cb = self._bucket_done
+
         if cfg.engine == "device":
             # bucket by length: each bucket compiles/pads at its own Lp —
             # padding every read to the global max wastes quadratically at
             # real PacBio length spreads (SURVEY §5.7)
-            sr_bytes = 3 * sr_all.codes.nbytes + sr_all.lengths.nbytes
-            resident = sr_bytes <= cfg.sr_device_budget
-            if not resident:
-                log.info(
-                    "short-read set %.1f GB exceeds sr-device-budget "
-                    "%.1f GB: streaming slab regime (per-pass upload)",
-                    sr_bytes / 2**30, cfg.sr_device_budget / 2**30)
-            sr_dev = _SrDevice(sr_all, resident=resident)
+            sr_dev = (prep[3] if prep is not None and prep[3] is not None
+                      else self._make_sr_device(sr_all))
             groups = _bucket_records(kept, cfg.batch_reads)
             obs.metrics.gauge("n_buckets", unit="buckets").set(len(groups))
             n_total = len(kept)
             n_done = 0
             t0 = time.monotonic()
             for gi, (pad, batch_recs) in enumerate(groups):
+                if gate is not None:
+                    # serving: drop reads the gate filters (cancelled /
+                    # deadline-breached jobs) BEFORE the key/Lp derive
+                    # from the bucket's content; may raise to drain
+                    batch_recs = gate(gi, len(groups), batch_recs)
+                    if not batch_recs:
+                        continue
+                    pad = max(len(r) for r in batch_recs)
                 want = int(pad * (1 + cfg.length_slack)) + 128
                 # Lp on a {2^k, 3*2^(k-1)} ladder: every distinct Lp is a
                 # fresh compile of the whole per-bucket program stack, and
@@ -520,6 +566,8 @@ class Pipeline:
                     _bucket_metrics(tb0, batch_recs)
                 results_final.extend(res_batch)
                 all_chim.extend(chim)
+                if done_cb is not None:
+                    done_cb(gi, res_batch, chim, hit is not None)
                 # progress/ETA between task lines (Verbose::ProgressBar
                 # role, lib/Verbose/ProgressBar.pm:36-62) — a scaled run
                 # otherwise logs nothing for minutes per bucket
@@ -539,6 +587,10 @@ class Pipeline:
             obs.metrics.gauge("n_buckets", unit="buckets").set(len(starts))
             for bi, start in enumerate(starts):
                 batch_recs = kept[start:start + cfg.batch_reads]
+                if gate is not None:
+                    batch_recs = gate(bi, len(starts), batch_recs)
+                    if not batch_recs:
+                        continue
                 key = bucket_key(batch_recs)
                 tb0 = time.monotonic()
                 with obs.span("bucket", cat="bucket", bucket=bi,
@@ -570,6 +622,8 @@ class Pipeline:
                 results_final.extend(res_batch)
                 all_chim.extend(chim)
                 untrimmed.extend(r.record for r in res_batch)
+                if done_cb is not None:
+                    done_cb(bi, res_batch, chim, hit is not None)
 
         if journal is not None and cfg.resume:
             log.info("resume: %d journal hit(s); journal now holds %d "
@@ -1343,6 +1397,12 @@ def _bucket_records(kept, batch_size: int,
         # long buckets must trade batch rows for length (SURVEY §5.7)
         gmax = max(len(r) for r in recs)
         eff = max(8, min(batch_size, CELL_BUDGET // max(gmax, 1)))
+        if len(recs) % eff and len(recs) % eff < min(8, len(recs)):
+            # the plain split would leave a runt tail group (< the 8-row
+            # floor the device batch pads to anyway): balance the SAME
+            # number of chunks instead — ceil(n/chunks) <= eff, so the
+            # cell budget still holds and no group runs nearly empty
+            eff = -(-len(recs) // (-(-len(recs) // eff)))
         for j in range(0, len(recs), eff):
             group = recs[j:j + eff]
             out.append((max(len(r) for r in group), group))

@@ -125,25 +125,40 @@ def classify_fault(exc: BaseException) -> Optional[str]:
 @contextmanager
 def soft_deadline(seconds: Optional[float], what: str = "bucket",
                   exc: type = BucketTimeout):
-    """Best-effort wall-clock budget around a blocking region: SIGALRM
-    raises ``exc`` (default :class:`BucketTimeout`) after ``seconds``.
-    No-op when ``seconds`` is falsy or off the main thread (signals only
-    deliver there).
+    """Best-effort wall-clock budget around a blocking region: raises
+    ``exc`` (default :class:`BucketTimeout`) after ``seconds``. No-op when
+    ``seconds`` is falsy.
+
+    On the MAIN thread the mechanism is SIGALRM (identical to the batch
+    CLI's historical behavior, including nested-timer re-arming). On any
+    OTHER thread — the correction server's worker threads
+    (``serve/server.py``), where signals never deliver — a daemon timer
+    thread injects ``exc`` into the armed thread via
+    ``PyThreadState_SetAsyncExc`` (:func:`_thread_deadline`), so ladder
+    rungs keep their wall-clock budget off the main thread too.
 
     Run-level budgets (``bench.py --wall-budget``) must pass
     ``exc=WallClockExceeded`` so the degradation ladder does not mistake
     the run deadline for a per-bucket one and demote instead of aborting.
 
-    Best-effort because a signal interrupts Python bytecode, not a blocked
-    C call — a wedged device RPC raises only when control returns to
-    Python. Nesting composes: the inner region arms the timer at
-    ``min(inner budget, outer remaining)`` — if the OUTER deadline falls
-    due inside the inner region, the outer handler fires there and then
-    (it is not suspended until the bucket exits) — and the outer timer is
-    re-armed with elapsed time subtracted on exit."""
-    if (not seconds or seconds <= 0
-            or threading.current_thread() is not threading.main_thread()):
+    Best-effort in both regimes because the interrupt lands between
+    Python bytecodes, not inside a blocked C call — a wedged device RPC
+    raises only when control returns to Python. Nesting composes: the
+    SIGALRM path arms the inner timer at ``min(inner budget, outer
+    remaining)`` — if the OUTER deadline falls due inside the inner
+    region, the outer handler fires there and then (it is not suspended
+    until the bucket exits) — and re-arms the outer timer with elapsed
+    time subtracted on exit; the thread path leaves every enclosing timer
+    armed and keeps a per-thread registry so a region exit revokes only
+    its OWN pending injection and re-delivers the nearest enclosing
+    deadline that already fired (see :func:`_thread_deadline` —
+    simultaneous firings share one pending slot, latest wins)."""
+    if not seconds or seconds <= 0:
         yield
+        return
+    if threading.current_thread() is not threading.main_thread():
+        with _thread_deadline(seconds, what=what, exc=exc):
+            yield
         return
 
     # cancel the (possible) outer timer first so we learn its remaining
@@ -172,6 +187,85 @@ def soft_deadline(seconds: Optional[float], what: str = "bucket",
             remaining = max(0.001,
                             prev_delay - (time.monotonic() - start))
             signal.setitimer(signal.ITIMER_REAL, remaining)
+
+
+# per-thread stack of armed async deadlines + the state whose injection
+# currently occupies the thread's single pending async-exc slot (CPython
+# keeps ONE pending exception per thread — the latest SetAsyncExc wins)
+_ASYNC_DEADLINES_LOCK = threading.Lock()
+_ASYNC_DEADLINES: dict = {}      # tid -> [state, ...] (outermost first)
+_ASYNC_PENDING: dict = {}        # tid -> state owning the pending slot
+
+
+def _async_inject(tid: int, exc) -> None:
+    import ctypes
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(tid),
+        ctypes.py_object(exc) if exc is not None else None)
+
+
+@contextmanager
+def _thread_deadline(seconds: float, what: str, exc: type):
+    """Thread-safe deadline for non-main threads: a daemon
+    ``threading.Timer`` injects ``exc`` into the armed thread with
+    ``PyThreadState_SetAsyncExc`` once the monotonic deadline passes.
+
+    The injected exception is the CLASS (CPython's async-exc contract),
+    so it carries no message — callers match on type, which is all
+    :func:`classify_fault` needs.
+
+    Nesting: a thread has ONE pending async-exc slot, so simultaneous
+    firings cannot both be pending — the latest firing wins the slot
+    (an outer deadline falling due inside an inner region therefore
+    fires there and then, like the SIGALRM path). The bookkeeping under
+    ``_ASYNC_DEADLINES_LOCK`` keeps exits honest: a region exit revokes
+    the pending injection only when it is its OWN (never an enclosing
+    timer's), and re-injects the nearest enclosing deadline that has
+    already fired — so an outer timeout that fired while the inner
+    region was winding down is delivered in the outer region instead of
+    being silently lost."""
+    tid = threading.get_ident()
+    state = {"live": True, "fired": False, "exc": exc}
+
+    def _fire():
+        with _ASYNC_DEADLINES_LOCK:
+            if not state["live"]:
+                return
+            state["fired"] = True
+            log.warning("%s: soft wall-clock deadline of %.0fs exceeded "
+                        "(worker thread %d)", what, seconds, tid)
+            _async_inject(tid, exc)
+            _ASYNC_PENDING[tid] = state
+
+    with _ASYNC_DEADLINES_LOCK:
+        _ASYNC_DEADLINES.setdefault(tid, []).append(state)
+    timer = threading.Timer(seconds, _fire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()
+        with _ASYNC_DEADLINES_LOCK:
+            state["live"] = False
+            stack = _ASYNC_DEADLINES.get(tid, [])
+            if state in stack:
+                stack.remove(state)
+            if not stack:
+                _ASYNC_DEADLINES.pop(tid, None)
+            if _ASYNC_PENDING.get(tid) is state:
+                # revoke OUR injection if it has not been delivered yet
+                # (delivery lands between bytecodes; if it already
+                # raised, the NULL injection is a harmless no-op and the
+                # exception propagates); then hand the slot to the
+                # nearest enclosing deadline that fired in the meantime
+                _async_inject(tid, None)
+                _ASYNC_PENDING.pop(tid, None)
+                for outer in reversed(stack):
+                    if outer["fired"] and outer["live"]:
+                        _async_inject(tid, outer["exc"])
+                        _ASYNC_PENDING[tid] = outer
+                        break
 
 
 # --------------------------------------------------------------------------

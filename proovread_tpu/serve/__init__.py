@@ -1,0 +1,29 @@
+"""Correction-as-a-service: the long-lived serving layer (docs/SERVING.md).
+
+``CorrectionServer`` accepts streaming FASTQ jobs over a local-socket
+JSONL protocol, admits reads from *different* jobs into the existing
+length buckets (continuous batching through ``pipeline/driver.py`` keeps
+the fused programs hot and amortizes the compile cache), and wraps every
+job in a robustness envelope: bounded per-tenant queues with explicit
+backpressure, per-tenant quota accounting, per-job deadlines and
+cancellation that unwind at bucket boundaries, graceful drain on SIGTERM
+(finish the in-flight bucket, journal the rest), and job-level
+retry/resume backed by the PR-1 checkpoint journal so a killed server
+restarted with ``--resume`` replays journaled jobs byte-identically.
+
+The batch CLI imports NOTHING from this package (tier-1 guard:
+tests/test_serve.py::test_batch_cli_never_imports_serve) — serving is
+zero-overhead when not serving.
+"""
+
+from proovread_tpu.serve.admission import AdmissionController, TenantQuota
+from proovread_tpu.serve.jobs import Job, JobJournal, TERMINAL_STATES
+from proovread_tpu.serve.protocol import ServeClient
+from proovread_tpu.serve.server import CorrectionServer, ServeConfig
+
+__all__ = [
+    "AdmissionController", "TenantQuota",
+    "Job", "JobJournal", "TERMINAL_STATES",
+    "ServeClient",
+    "CorrectionServer", "ServeConfig",
+]

@@ -1,0 +1,195 @@
+"""End-to-end serving smoke (``make serve-smoke``).
+
+Boots the correction server on CPU with a deterministic mixed-traffic
+stream (``io/simulate.py:simulate_job_stream`` — CLR + CCS + unitig jobs
+from two tenants) and ONE injected fault per job-level class
+(``testing/faults.py``)::
+
+    parse@j1x1      job 1's submission is unparseable  -> rejected
+    quota@j2x1      job 2 hits tenant quota            -> rejected+retry-after
+    deadline@j3x1   job 3's deadline breaches          -> expired
+    worker@j4x1     the worker dies mid-wave           -> wave retried
+    journal@j5      job 5's journal entry corrupts     -> failed at resume
+
+then drains mid-run (the ``drain_after_buckets`` knob — the deterministic
+stand-in for the SIGTERM that is ALSO sent and handled), restarts the
+server with ``resume=True`` on the same state dir, and asserts the whole
+envelope:
+
+* drain is clean, in-flight buckets finished, the rest journaled;
+* after resume, EVERY submitted job is terminal with the expected
+  status — nothing silently lost (the corrupt entry surfaces as a
+  ``failed``/``journal-corrupt`` job);
+* both SLO artifacts validate strictly (``obs.validate.validate_slo``),
+  the final one with ``require_drained``;
+* no live-array leak once the servers are gone (PR-4 ``LeakCheck``).
+
+Runs on CPU in ~a minute (interpret-mode Pallas device engine, tiny
+genome).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+FAULTS = "parse@j1x1;quota@j2x1;deadline@j3x1;worker@j4x1;journal@j5"
+
+
+def _log(msg: str) -> None:
+    print(f"[serve-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def main(argv=None) -> int:
+    from proovread_tpu.io.simulate import (simulate_job_stream,
+                                           simulate_short_reads)
+    from proovread_tpu.obs.memory import LeakCheck
+    from proovread_tpu.obs.validate import ValidationError, validate_slo
+    from proovread_tpu.pipeline.driver import PipelineConfig
+    from proovread_tpu.pipeline.trim import TrimParams
+    from proovread_tpu.serve.protocol import ServeClient
+    from proovread_tpu.serve.server import CorrectionServer, ServeConfig
+
+    genome, jobs = simulate_job_stream(seed=23, n_jobs=8,
+                                       genome_size=1600, mean_len=420,
+                                       min_len=300)
+    shorts = simulate_short_reads(genome, 22.0, seed=24)
+    _log(f"workload: {len(jobs)} jobs "
+         f"({'/'.join(j.mode for j in jobs)}), "
+         f"{len(shorts)} short reads")
+    pcfg = PipelineConfig(engine="device", n_iterations=2, sampling=False,
+                          batch_reads=8, device_chunk=128,
+                          host_chunk_rows=512,
+                          trim=TrimParams(min_length=150))
+
+    leak = LeakCheck()
+    with tempfile.TemporaryDirectory(prefix="proovread_serve_") as tmp:
+        state = os.path.join(tmp, "state")
+        sock = os.path.join(tmp, "serve.sock")
+        slo1 = os.path.join(tmp, "slo1.json")
+        slo2 = os.path.join(tmp, "slo2.json")
+
+        # -- phase 1: boot, inject one fault per class, drain mid-run ----
+        srv = CorrectionServer(shorts, ServeConfig(
+            state_dir=state, socket_path=sock, slo_path=slo1,
+            max_wave_jobs=3, job_retries=3, qc=True,
+            fault_spec=FAULTS, drain_after_buckets=1), pcfg)
+        srv.install_signal_handlers()
+        srv.start(worker=False)        # listener up, worker gated
+        expect_rejected = {}
+        with ServeClient(sock) as cli:
+            assert cli.ping()["ok"]
+            for j in jobs:
+                r = cli.submit(j.job_id, j.tenant, j.records, mode=j.mode)
+                _log(f"submit {j.job_id} ({j.mode}): {r['status']}"
+                     + (f" [{r.get('reason')}"
+                        f" retry_after={r.get('retry_after_s')}]"
+                        if r["status"] == "rejected" else ""))
+                if r["status"] == "rejected":
+                    expect_rejected[j.job_id] = r["reason"]
+                    if r["reason"].startswith("quota"):
+                        assert r.get("retry_after_s", 0) > 0, \
+                            "backpressure rejection lacks retry_after_s"
+            srv.start_worker()
+            # the deterministic mid-wave drain (drain_after_buckets=1)
+            # plus the real signal path on top (idempotent)
+            t0 = time.monotonic()
+            while not srv._drain.is_set():
+                if time.monotonic() - t0 > 300:
+                    _log("FAILED: drain never triggered")
+                    return 1
+                time.sleep(0.05)
+            os.kill(os.getpid(), signal.SIGTERM)
+        clean = srv.join(timeout=300)
+        if not clean:
+            _log("FAILED: phase-1 drain not clean")
+            return 1
+        snap1 = srv.slo_snapshot()
+        _log(f"phase 1 drained: jobs={json.dumps(snap1['jobs'])} "
+             f"rejections={json.dumps(snap1['rejections'])}")
+        if sorted(expect_rejected.values()) != ["parse-error",
+                                                "quota-jobs"]:
+            _log(f"FAILED: expected one parse + one quota rejection, "
+                 f"got {expect_rejected}")
+            return 1
+        if snap1["jobs"]["journaled"] == 0:
+            _log("FAILED: drain left nothing journaled — the mid-run "
+                 "drain did not exercise resume")
+            return 1
+        try:
+            validate_slo(slo1)
+        except ValidationError as e:
+            _log(f"FAILED: phase-1 SLO invalid: {e}")
+            return 1
+        del srv
+
+        # -- phase 2: restart + resume on the same state dir -------------
+        srv2 = CorrectionServer(shorts, ServeConfig(
+            state_dir=state, socket_path=sock, slo_path=slo2,
+            max_wave_jobs=3, job_retries=3, qc=True,
+            fault_spec=FAULTS, resume=True), pcfg)
+        srv2.start()
+        with ServeClient(sock) as cli:
+            expected = {
+                jobs[0].job_id: ("completed", ""),
+                jobs[3].job_id: ("expired", "deadline"),
+                jobs[4].job_id: ("completed", ""),
+                jobs[5].job_id: ("failed", "journal-corrupt"),
+                jobs[6].job_id: ("completed", ""),
+                jobs[7].job_id: ("completed", ""),
+            }
+            ok = True
+            for jid, (want, why) in expected.items():
+                st = cli.wait(jid, timeout=300)
+                got = st.get("status")
+                if got != want or (why and why not in st.get("reason", "")):
+                    _log(f"FAILED: job {jid}: wanted {want}"
+                         f"{f'/{why}' if why else ''}, got {got} "
+                         f"({st.get('reason')!r})")
+                    ok = False
+                else:
+                    _log(f"job {jid}: {got}"
+                         + (f" ({st['reason']})" if st.get("reason")
+                            else ""))
+            if not ok:
+                return 1
+            # completed jobs must serve their results (with QC payloads)
+            res = cli.result(jobs[0].job_id)
+            if not res["ok"] or not res["untrimmed"] or res["qc"] is None:
+                _log(f"FAILED: result op broken: "
+                     f"{json.dumps(res)[:300]}")
+                return 1
+            cli.drain()
+        clean = srv2.join(timeout=300)
+        if not clean:
+            _log("FAILED: phase-2 drain not clean")
+            return 1
+        try:
+            stats = validate_slo(slo2, require_drained=True)
+        except ValidationError as e:
+            _log(f"FAILED: phase-2 SLO invalid: {e}")
+            return 1
+        if stats["jobs"]["journaled"] != 0:
+            _log(f"FAILED: jobs left journaled after full drain: "
+                 f"{stats['jobs']}")
+            return 1
+        _log(f"phase 2 SLO OK: {json.dumps(stats)}")
+        del srv2
+
+    gc.collect()
+    lrep = leak.report()
+    if lrep["leaked_bytes"] > 1 << 20:
+        _log(f"FAILED: live-array leak after server shutdown: {lrep}")
+        return 1
+    _log(f"leak check OK: {json.dumps(lrep)}")
+    _log("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,11 +14,15 @@ journal (``pipeline/resilience.py``) are testable in tier-1.
 
 Spec grammar (semicolon- or comma-separated rules)::
 
-    <kind>@b<bucket>[.p<pass>][x<count>]
+    <kind>@b<bucket>[.p<pass>][x<count>]        device-site rules
+    <kind>@j<job>[x<count>]                     job-site rules (serving)
     <kind>@*[.p<pass>][x<count>]
 
-    kind    compile | oom | timeout | kernel
+    kind    device sites: compile | oom | timeout | kernel
+            job sites:    parse | worker | deadline | quota | journal
     bucket  0-based length-bucket index ('*' = any bucket)
+    job     0-based job SUBMISSION ordinal within one server lifetime
+            ('*' = any job); only valid for the job-site kinds
     pass    1..n_iterations; n_iterations+1 addresses the finish pass.
             Omitted = the rule fires at ANY device site of the bucket,
             including the bucket-entry site.
@@ -28,11 +32,24 @@ Spec grammar (semicolon- or comma-separated rules)::
 
 Examples: ``compile@b0.p2`` (compile failure at bucket 0, pass 2, every
 device attempt), ``oom@b1`` (OOM on any device work in bucket 1),
-``timeout@b2.p1x1`` (one single injected timeout).
+``timeout@b2.p1x1`` (one single injected timeout), ``worker@j3x1`` (the
+correction worker dies once while a wave containing job 3 is mid-flight).
 
-Faults are only raised from device-path sites, so the host ``engine="scan"``
-rung — and the scan engine itself — always completes, mirroring reality:
-the host path has no XLA compile step or device memory to exhaust.
+Device faults are only raised from device-path sites, so the host
+``engine="scan"`` rung — and the scan engine itself — always completes,
+mirroring reality: the host path has no XLA compile step or device memory
+to exhaust.
+
+Job faults (``serve/``, docs/SERVING.md) address the serving envelope
+instead of the device: ``parse`` rejects a job's submission as malformed,
+``worker`` kills the correction worker mid-wave (the job-level
+retry/resume path), ``deadline`` forces the job's deadline to breach,
+``quota`` forces its tenant's quota to read as exhausted at admission,
+and ``journal`` corrupts the job's journal entry after it is written (a
+restart must detect it — never silently lose the job). They derive from
+:class:`InjectedJobFault`, which is deliberately NOT a ``RuntimeError``:
+``resilience.classify_fault`` returns ``None`` for them, so the
+degradation ladder never absorbs a serving-layer fault as a device one.
 """
 
 from __future__ import annotations
@@ -45,6 +62,7 @@ from typing import List, Optional
 log = logging.getLogger("proovread_tpu")
 
 KINDS = ("compile", "oom", "timeout", "kernel")
+JOB_KINDS = ("parse", "worker", "deadline", "quota", "journal")
 
 
 class InjectedFault(RuntimeError):
@@ -70,6 +88,35 @@ class BucketTimeout(RuntimeError):
     ``timeout`` kind and by ``resilience.soft_deadline``'s SIGALRM handler."""
 
 
+class InjectedJobFault(Exception):
+    """Base class for injected SERVING-layer faults (job sites). Not a
+    RuntimeError on purpose: ``resilience.classify_fault`` must return
+    ``None`` so the device degradation ladder never absorbs one."""
+
+
+class InjectedParseError(InjectedJobFault):
+    """Stands in for a malformed job submission (bad JSON, bad payload)."""
+
+
+class InjectedWorkerDeath(InjectedJobFault):
+    """Stands in for the correction worker dying mid-wave (the process
+    analog is ``kill -9``); the server's job-level retry must requeue the
+    wave's jobs and the bucket journal makes the retry cheap."""
+
+
+class InjectedDeadlineBreach(InjectedJobFault):
+    """Forces a job's deadline to read as already breached."""
+
+
+class InjectedQuotaExhausted(InjectedJobFault):
+    """Forces the submitting tenant's quota to read as exhausted."""
+
+
+class InjectedJournalCorruption(InjectedJobFault):
+    """Marks a job's journal entry for post-write corruption (simulated
+    disk corruption; atomic writes cannot prevent it)."""
+
+
 class WallClockExceeded(Exception):
     """A RUN-level wall budget breach (``bench.py --wall-budget``).
 
@@ -90,11 +137,26 @@ def make_fault(kind: str, where: str) -> Exception:
             f"Mosaic kernel fault (injected at {where})")
     if kind == "timeout":
         return BucketTimeout(f"injected bucket timeout at {where}")
+    if kind == "parse":
+        return InjectedParseError(f"unparseable job payload (injected at "
+                                  f"{where})")
+    if kind == "worker":
+        return InjectedWorkerDeath(f"correction worker died (injected at "
+                                   f"{where})")
+    if kind == "deadline":
+        return InjectedDeadlineBreach(f"job deadline breached (injected "
+                                      f"at {where})")
+    if kind == "quota":
+        return InjectedQuotaExhausted(f"tenant quota exhausted (injected "
+                                      f"at {where})")
+    if kind == "journal":
+        return InjectedJournalCorruption(f"journal entry corrupted "
+                                         f"(injected at {where})")
     raise ValueError(f"unknown fault kind {kind!r}")
 
 
 _RULE_RE = re.compile(
-    r"^(?P<kind>[a-z]+)@(?:b(?P<bucket>\d+)|(?P<any>\*))"
+    r"^(?P<kind>[a-z]+)@(?:b(?P<bucket>\d+)|j(?P<job>\d+)|(?P<any>\*))"
     r"(?:\.p(?P<pass>\d+))?(?:x(?P<count>\d+))?$")
 
 
@@ -104,14 +166,26 @@ class FaultRule:
     bucket: Optional[int]        # None = any bucket
     pass_: Optional[int]         # None = any site of the bucket
     count: Optional[int]         # None = unlimited firings
+    job: Optional[int] = None    # job-site rules: submission ordinal
     fired: int = 0
 
     def matches(self, bucket: int, pass_: Optional[int]) -> bool:
+        if self.kind in JOB_KINDS:
+            return False
         if self.count is not None and self.fired >= self.count:
             return False
         if self.bucket is not None and self.bucket != bucket:
             return False
         if self.pass_ is not None and self.pass_ != pass_:
+            return False
+        return True
+
+    def matches_job(self, job: int, site: str) -> bool:
+        if self.kind != site:
+            return False
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.job is not None and self.job != job:
             return False
         return True
 
@@ -134,15 +208,27 @@ class FaultPlan:
             if not m:
                 raise ValueError(
                     f"bad PROOVREAD_FAULT rule {part!r} "
-                    "(expected kind@bN[.pM][xK] or kind@*[.pM][xK])")
+                    "(expected kind@bN[.pM][xK] / kind@*[.pM][xK] for "
+                    "device kinds, kind@jN[xK] / kind@*[xK] for job "
+                    "kinds)")
             kind = m.group("kind")
-            if kind not in KINDS:
+            if kind not in KINDS and kind not in JOB_KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r} in {part!r} "
-                    f"(known: {', '.join(KINDS)})")
+                    f"(known: {', '.join(KINDS + JOB_KINDS)})")
+            if kind in JOB_KINDS and (m.group("bucket") or m.group("pass")):
+                raise ValueError(
+                    f"job-site kind {kind!r} takes @jN or @* addressing, "
+                    f"not bucket/pass sites ({part!r})")
+            if kind in KINDS and m.group("job"):
+                raise ValueError(
+                    f"device-site kind {kind!r} takes @bN or @* "
+                    f"addressing, not @j job sites ({part!r})")
             rules.append(FaultRule(
                 kind=kind,
-                bucket=None if m.group("any") else int(m.group("bucket")),
+                bucket=(int(m.group("bucket")) if m.group("bucket")
+                        else None),
+                job=int(m.group("job")) if m.group("job") else None,
                 pass_=int(m.group("pass")) if m.group("pass") else None,
                 count=int(m.group("count")) if m.group("count") else None))
         return cls(rules)
@@ -163,6 +249,28 @@ class FaultPlan:
                             r.kind, where, r.fired,
                             f"/{r.count}" if r.count else "")
                 raise make_fault(r.kind, where)
+
+    def fires_job(self, job: int, site: str) -> bool:
+        """Consume one firing of a job-site rule matching (``job``,
+        ``site``) and return True — without raising. The ``journal``
+        site uses this: its effect is corrupting a file after the write,
+        not an exception at the call site."""
+        for r in self.rules:
+            if r.matches_job(job, site):
+                r.fired += 1
+                log.warning(
+                    "fault injection: %s at job %d (rule fired %d%s)",
+                    r.kind, job, r.fired,
+                    f"/{r.count}" if r.count else "")
+                return True
+        return False
+
+    def check_job(self, job: int, site: str) -> None:
+        """Raise the injected job fault if a rule matches this serving
+        site (``parse`` / ``worker`` / ``deadline`` / ``quota``).
+        ``job`` is the submission ordinal within one server lifetime."""
+        if self.fires_job(job, site):
+            raise make_fault(site, f"job {job}")
 
     def check_span(self, bucket: int, pass_lo: int, pass_hi: int) -> None:
         """Raise if any pass index in ``[pass_lo, pass_hi]`` matches — the

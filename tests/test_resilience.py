@@ -102,6 +102,50 @@ class TestSoftDeadline:
         with soft_deadline(0):
             pass
 
+    def test_times_out_in_worker_thread(self):
+        """Server worker threads never see SIGALRM — the thread path
+        injects the exception class via PyThreadState_SetAsyncExc, so
+        ladder rungs keep their wall-clock budget off the main thread."""
+        import threading
+        import time
+        from proovread_tpu.pipeline.resilience import soft_deadline
+        out = {}
+
+        def work():
+            try:
+                with soft_deadline(0.05, what="worker-bucket"):
+                    t0 = time.monotonic()
+                    while time.monotonic() - t0 < 5:
+                        pass
+                out["r"] = "completed"
+            except BucketTimeout:
+                out["r"] = "timeout"
+        t = threading.Thread(target=work)
+        t.start()
+        t.join(timeout=10)
+        assert out["r"] == "timeout"
+
+    def test_worker_thread_no_late_injection(self):
+        """A region that finishes under budget must not be hit by a late
+        timer: the exit handshake revokes the pending injection."""
+        import threading
+        import time
+        from proovread_tpu.pipeline.resilience import soft_deadline
+        out = {}
+
+        def work():
+            try:
+                with soft_deadline(0.1, what="quick"):
+                    pass
+                time.sleep(0.3)       # past the armed deadline
+                out["r"] = "clean"
+            except BucketTimeout:
+                out["r"] = "late-injection"
+        t = threading.Thread(target=work)
+        t.start()
+        t.join(timeout=10)
+        assert out["r"] == "clean"
+
     def test_outer_deadline_fires_inside_inner_region(self):
         """A run-level budget (bench --wall-budget) must fire even while a
         longer per-bucket deadline is armed — the inner region arms
@@ -116,6 +160,37 @@ class TestSoftDeadline:
                     t0 = time.time()
                     while time.time() - t0 < 5:
                         pass
+
+    def test_outer_deadline_wins_nested_in_worker_thread(self):
+        """Same run-vs-bucket nesting OFF the main thread: the outer
+        WallClockExceeded must surface (abort), never be lost to the
+        inner region's exit handshake nor mistaken for a BucketTimeout
+        the ladder would absorb."""
+        import threading
+        import time
+        from proovread_tpu.pipeline.resilience import soft_deadline
+        from proovread_tpu.testing.faults import WallClockExceeded
+        out = {}
+
+        def work():
+            try:
+                with soft_deadline(0.1, what="run",
+                                   exc=WallClockExceeded):
+                    try:
+                        with soft_deadline(10.0, what="bucket"):
+                            t0 = time.monotonic()
+                            while time.monotonic() - t0 < 5:
+                                pass
+                    except BucketTimeout:
+                        out["r"] = "ladder-absorbed"
+                        return
+                out["r"] = "completed"
+            except WallClockExceeded:
+                out["r"] = "outer"
+        t = threading.Thread(target=work)
+        t.start()
+        t.join(timeout=15)
+        assert out["r"] == "outer"
 
 
 # --------------------------------------------------------------------------

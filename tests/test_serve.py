@@ -1,0 +1,481 @@
+"""Serving-layer tests: admission/backpressure, the job journal, job-level
+fault drills, drain/resume, and the server-vs-batch parity acceptance
+(docs/SERVING.md). Everything runs on CPU; `make test-faults` selects
+this suite alongside the resilience drills."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from proovread_tpu.io.records import SeqRecord
+from proovread_tpu.io.simulate import (random_genome, simulate_job_stream,
+                                       simulate_short_reads)
+from proovread_tpu.ops.encode import decode_codes, revcomp_codes
+from proovread_tpu.pipeline.driver import Pipeline, PipelineConfig
+from proovread_tpu.pipeline.trim import TrimParams
+from proovread_tpu.serve.admission import AdmissionController, TenantQuota
+from proovread_tpu.serve.jobs import Job, JobJournal
+from proovread_tpu.serve.protocol import (decode_record, decode_records,
+                                          encode_record)
+from proovread_tpu.serve.server import (CorrectionServer, ServeConfig,
+                                        length_class)
+from proovread_tpu.testing.faults import FaultPlan
+
+pytestmark = pytest.mark.faults
+
+
+# --------------------------------------------------------------------------
+# zero overhead when not serving
+# --------------------------------------------------------------------------
+
+def test_batch_cli_never_imports_serve(tmp_path):
+    """Acceptance: the batch CLI path imports nothing from serve/."""
+    code = (
+        "import sys\n"
+        "from proovread_tpu import cli\n"
+        f"rc = cli.main(['--create-cfg', {str(tmp_path / 'x.cfg')!r}])\n"
+        "assert rc == 0\n"
+        "bad = [m for m in sys.modules"
+        " if m.startswith('proovread_tpu.serve')]\n"
+        "assert not bad, f'serve modules leaked into batch path: {bad}'\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True,
+                   cwd=os.path.dirname(os.path.dirname(__file__)))
+
+
+# --------------------------------------------------------------------------
+# unit: protocol codec
+# --------------------------------------------------------------------------
+
+class TestProtocolCodec:
+    def test_record_roundtrip(self):
+        r = SeqRecord("a/1", "ACGTN", qual=np.array([1, 2, 3, 4, 40],
+                                                    np.uint8))
+        d = encode_record(r)
+        r2 = decode_record(json.loads(json.dumps(d)))
+        assert r2.id == r.id and r2.seq == r.seq
+        np.testing.assert_array_equal(r2.qual, r.qual)
+
+    def test_qual_none_roundtrip(self):
+        r2 = decode_record(encode_record(SeqRecord("x", "AC")))
+        assert r2.qual is None
+
+    def test_bad_payload_rejected(self):
+        with pytest.raises(ValueError):
+            decode_record({"id": 5, "seq": "AC"})
+        with pytest.raises(ValueError):
+            decode_records({"not": "a list"})
+
+
+# --------------------------------------------------------------------------
+# unit: admission / backpressure
+# --------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_quota_bounds_and_release(self):
+        a = AdmissionController(TenantQuota(max_jobs=2, max_bases=1000,
+                                            max_server_jobs=10))
+        assert a.try_admit("t1", 400)[0]
+        assert a.try_admit("t1", 400)[0]
+        ok, reason, retry = a.try_admit("t1", 100)
+        assert not ok and reason == "quota-jobs" and retry > 0
+        # other tenants unaffected, but bases quota still bites
+        ok, reason, _ = a.try_admit("t2", 1200)
+        assert not ok and reason == "quota-bases"
+        a.release("t1", 400)
+        assert a.try_admit("t1", 100)[0]
+
+    def test_server_wide_bound(self):
+        a = AdmissionController(TenantQuota(max_jobs=99, max_bases=10**9,
+                                            max_server_jobs=3))
+        for i in range(3):
+            assert a.try_admit(f"t{i}", 10)[0]
+        ok, reason, _ = a.try_admit("t9", 10)
+        assert not ok and reason == "queue-full"
+
+    def test_retry_after_tracks_drain_rate(self):
+        a = AdmissionController(TenantQuota(max_jobs=1))
+        assert a.try_admit("t", 10_000)[0]
+        a.observe_rate(10_000, 2.0)          # 5k bases/s
+        ok, _, retry = a.try_admit("t", 10_000)
+        assert not ok
+        # ~(10k held + 10k extra) / 5k = ~4s, clamped sane
+        assert 0.5 <= retry <= 60.0 and retry == pytest.approx(4.0, rel=0.5)
+
+    def test_charge_bypasses_gate(self):
+        a = AdmissionController(TenantQuota(max_jobs=1))
+        a.charge("t", 10)
+        a.charge("t", 10)                    # resume re-holds, no reject
+        assert a.held_jobs("t") == 2
+
+
+# --------------------------------------------------------------------------
+# unit: job journal
+# --------------------------------------------------------------------------
+
+def _job(jid="j1", seq=0, **kw):
+    recs = kw.pop("records", [SeqRecord("r1", "ACGT",
+                                        qual=np.array([1, 2, 3, 4],
+                                                      np.uint8))])
+    return Job(job_id=jid, tenant="t", mode="clr", records=recs, seq=seq,
+               **kw)
+
+
+class TestJobJournal:
+    def test_roundtrip(self, tmp_path):
+        j = JobJournal(str(tmp_path / "jobs"))
+        job = _job(status="running", wave=3, attempts=1)
+        j.put(job)
+        jobs, corrupt = JobJournal(str(tmp_path / "jobs")).load()
+        assert not corrupt
+        (j2,) = jobs
+        assert (j2.job_id, j2.status, j2.wave, j2.attempts) == \
+            ("j1", "running", 3, 1)
+        assert j2.records[0].seq == "ACGT"
+        np.testing.assert_array_equal(j2.records[0].qual,
+                                      job.records[0].qual)
+
+    def test_corrupt_entry_surfaces_not_raises(self, tmp_path):
+        j = JobJournal(str(tmp_path / "jobs"))
+        j.put(_job("good", seq=0))
+        j.put(_job("bad", seq=1))
+        victim = [n for n in os.listdir(j.path) if "bad" in n][0]
+        with open(os.path.join(j.path, victim), "r+b") as fh:
+            fh.truncate(20)
+        jobs, corrupt = JobJournal(str(tmp_path / "jobs")).load()
+        assert [jb.job_id for jb in jobs] == ["good"]
+        assert [(c[0], c[2]) for c in corrupt] == [("bad", 1)]
+        # quarantine keeps the evidence but stops the reload
+        JobJournal(str(tmp_path / "jobs")).quarantine(corrupt[0][1])
+        jobs, corrupt = JobJournal(str(tmp_path / "jobs")).load()
+        assert [jb.job_id for jb in jobs] == ["good"] and not corrupt
+
+    def test_journal_fault_site_corrupts_nonterminal_only(self, tmp_path):
+        plan = FaultPlan.from_spec("journal@j7")
+        j = JobJournal(str(tmp_path / "jobs"), faults=plan)
+        j.put(_job("pending", seq=7, status="accepted"))
+        _, corrupt = JobJournal(str(tmp_path / "jobs")).load()
+        assert [c[0] for c in corrupt] == ["pending"]
+        done = _job("done", seq=7, status="completed")
+        j.put(done)        # terminal writes are never the drill target
+        jobs, corrupt2 = JobJournal(str(tmp_path / "jobs")).load()
+        assert "done" in [jb.job_id for jb in jobs]
+
+
+# --------------------------------------------------------------------------
+# unit: misc
+# --------------------------------------------------------------------------
+
+def test_length_class_buckets():
+    assert length_class(10) == "512"
+    assert length_class(513) == "1024"
+    assert length_class(40_000) == "huge"
+
+
+def test_job_stream_deterministic_and_mixed():
+    g1, a = simulate_job_stream(seed=5, n_jobs=6)
+    g2, b = simulate_job_stream(seed=5, n_jobs=6)
+    assert [j.job_id for j in a] == [j.job_id for j in b]
+    assert all([r.seq for r in x.records] == [r.seq for r in y.records]
+               for x, y in zip(a, b))
+    assert {j.mode for j in a} == {"clr", "ccs", "unitig"}
+    assert len({j.tenant for j in a}) > 1
+    ids = [r.id for j in a for r in j.records]
+    assert len(ids) == len(set(ids))
+    from proovread_tpu.pipeline.ccs import is_subread_set
+    for j in a:
+        if j.mode == "ccs":
+            assert is_subread_set(j.records)
+
+
+# --------------------------------------------------------------------------
+# server-level drills (in-process, scan engine, deterministic pump())
+# --------------------------------------------------------------------------
+
+def _dataset(seed=31, n_jobs=4, genome_size=1500, **kw):
+    genome, jobs = simulate_job_stream(
+        seed=seed, n_jobs=n_jobs, genome_size=genome_size,
+        modes=("clr",), mean_len=420, min_len=300, **kw)
+    shorts = simulate_short_reads(genome, 22.0, seed=seed + 1)
+    return genome, jobs, shorts
+
+
+def _pcfg(**kw):
+    base = dict(engine="scan", n_iterations=2, sampling=False,
+                batch_reads=8, host_chunk_rows=512,
+                trim=TrimParams(min_length=150))
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+def _submit(srv, j, **extra):
+    return srv.handle({"op": "submit", "job_id": j.job_id,
+                       "tenant": j.tenant, "mode": j.mode,
+                       "reads": [encode_record(r) for r in j.records],
+                       **extra})
+
+
+@pytest.mark.heavy
+class TestServerDrills:
+    def test_backpressure_bounded_and_observable(self, tmp_path):
+        _, jobs, shorts = _dataset(n_jobs=4)
+        srv = CorrectionServer(shorts, ServeConfig(
+            state_dir=str(tmp_path / "s"),
+            quota=TenantQuota(max_jobs=1, max_bases=10**9)), _pcfg())
+        assert _submit(srv, jobs[0])["status"] == "accepted"
+        # tenant t-alice holds one job -> the next alice job bounces with
+        # an explicit retry-after; bob is unaffected
+        r = _submit(srv, jobs[2])            # same tenant as jobs[0]
+        assert r["status"] == "rejected" and r["reason"] == "quota-jobs"
+        assert r["retry_after_s"] > 0
+        assert _submit(srv, jobs[1])["status"] == "accepted"
+        while srv.pump():
+            pass
+        # quota released on completion: the bounced job submits clean now
+        assert _submit(srv, jobs[2])["status"] == "accepted"
+        while srv.pump():
+            pass
+        snap = srv.slo_snapshot()
+        assert snap["jobs"]["completed"] == 3
+        assert snap["rejections"] == {"quota-jobs": 1}
+        from proovread_tpu.obs.validate import validate_slo
+        slo = tmp_path / "slo.json"
+        srv.write_slo(str(slo))
+        stats = validate_slo(str(slo))
+        assert stats["jobs"]["accepted"] == 3
+
+    def test_bad_submissions_rejected_with_reason(self, tmp_path):
+        _, jobs, shorts = _dataset(n_jobs=2)
+        srv = CorrectionServer(shorts, ServeConfig(
+            state_dir=str(tmp_path / "s")), _pcfg())
+        r = srv.handle({"op": "submit", "job_id": "x", "tenant": "t"})
+        assert r["status"] == "rejected" and r["reason"] == "parse-error"
+        r = _submit(srv, jobs[0], mode="nope")
+        assert r["status"] == "rejected" and r["reason"] == "bad-request"
+        assert _submit(srv, jobs[0])["status"] == "accepted"
+        r = _submit(srv, jobs[0])
+        assert r["status"] == "rejected" and r["reason"] == "duplicate-job"
+        assert srv.handle({"op": "bogus"})["ok"] is False
+
+    def test_cancel_and_deadline_unwind_cleanly(self, tmp_path):
+        _, jobs, shorts = _dataset(n_jobs=3)
+        srv = CorrectionServer(shorts, ServeConfig(
+            state_dir=str(tmp_path / "s")), _pcfg())
+        _submit(srv, jobs[0])
+        _submit(srv, jobs[1], deadline_s=0.0)    # breached before wave
+        _submit(srv, jobs[2])
+        assert srv.handle({"op": "cancel",
+                           "job_id": jobs[2].job_id})["ok"]
+        while srv.pump():
+            pass
+        sts = {j.job_id: srv.handle({"op": "status", "job_id": j.job_id})
+               for j in jobs}
+        assert sts[jobs[0].job_id]["status"] == "completed"
+        assert sts[jobs[1].job_id]["status"] == "expired"
+        assert sts[jobs[2].job_id]["status"] == "cancelled"
+        # the neighbor job is served, the unwound ones return no partials
+        assert srv.handle({"op": "result",
+                           "job_id": jobs[0].job_id})["ok"]
+        assert not srv.handle({"op": "result",
+                               "job_id": jobs[1].job_id})["ok"]
+
+    def test_worker_death_retries_then_completes(self, tmp_path):
+        _, jobs, shorts = _dataset(n_jobs=2)
+        srv = CorrectionServer(shorts, ServeConfig(
+            state_dir=str(tmp_path / "s"), job_retries=1,
+            fault_spec="worker@j0x1"), _pcfg())
+        _submit(srv, jobs[0])
+        _submit(srv, jobs[1])
+        while srv.pump():
+            pass
+        for j in jobs:
+            st = srv.handle({"op": "status", "job_id": j.job_id})
+            assert st["status"] == "completed", st
+            assert st["attempts"] == 2        # died once, retried once
+        assert srv.registry.counter("serve_wave_deaths",
+                                    "waves").value() == 1
+
+    def test_worker_death_exhausts_retries_to_failed(self, tmp_path):
+        _, jobs, shorts = _dataset(n_jobs=1)
+        srv = CorrectionServer(shorts, ServeConfig(
+            state_dir=str(tmp_path / "s"), job_retries=1,
+            fault_spec="worker@j0"), _pcfg())     # unlimited firings
+        _submit(srv, jobs[0])
+        while srv.pump():
+            pass
+        st = srv.handle({"op": "status", "job_id": jobs[0].job_id})
+        assert st["status"] == "failed"
+        assert "worker died" in st["reason"]
+
+
+# --------------------------------------------------------------------------
+# acceptance: server <-> batch parity, incl. kill + --resume
+# --------------------------------------------------------------------------
+
+def _records_equal(a, b):
+    assert len(a) == len(b), (len(a), len(b))
+    for x, y in zip(a, b):
+        assert x.id == y.id
+        assert x.seq == y.seq, x.id
+        if x.qual is None or y.qual is None:
+            assert x.qual is None and y.qual is None
+        else:
+            np.testing.assert_array_equal(x.qual, y.qual)
+
+
+def _job_slice(records, job):
+    """The batch run's records restricted to one job's reads (trim may
+    suffix piece ids with .N)."""
+    ids = {r.id for r in job.records}
+    out = []
+    for r in records:
+        base = r.id
+        stem, _, sfx = base.rpartition(".")
+        if base in ids or (sfx.isdigit() and stem in ids):
+            out.append(r)
+    return out
+
+
+def _batch_reference(longs, shorts, cfg):
+    """One batch run over the union, with QC recorded — the ground truth
+    the server must reproduce byte-identically."""
+    from proovread_tpu import obs
+    from proovread_tpu.obs.qc import QcRecorder
+    with obs.qc.scope(QcRecorder()):
+        res = Pipeline(cfg).run(longs, shorts)
+    return res
+
+
+def _server_qc_aggregate(jobs, srv):
+    """Aggregate over the per-job QC payloads, exactly as a client would
+    reassemble provenance from job results."""
+    from proovread_tpu.obs.qc import QcRecorder
+    rec = QcRecorder()
+    for j in jobs:
+        res = srv.handle({"op": "result", "job_id": j.job_id})
+        assert res["ok"], res
+        assert res["qc"] is not None
+        rec.splice(res["qc"])
+    return rec.aggregate()
+
+
+@pytest.mark.heavy
+class TestServerBatchParity:
+    def test_single_wave_matches_batch_with_qc(self, tmp_path):
+        """Interleaved jobs submitted to the server vs ONE batch run of
+        the same reads: identical corrected records, trimmed records and
+        QC aggregate."""
+        _, jobs, shorts = _dataset(seed=37, n_jobs=4)
+        union = [r for j in jobs for r in j.records]
+        ref = _batch_reference(union, shorts, _pcfg())
+
+        srv = CorrectionServer(shorts, ServeConfig(
+            state_dir=str(tmp_path / "s"), qc=True, max_wave_jobs=8),
+            _pcfg())
+        for j in jobs:
+            assert _submit(srv, j)["status"] == "accepted"
+        while srv.pump():
+            pass
+        for j in jobs:
+            res = srv.handle({"op": "result", "job_id": j.job_id})
+            assert res["ok"], res
+            _records_equal(decode_records(res["untrimmed"]),
+                           [r for r in ref.untrimmed
+                            if r.id in {x.id for x in j.records}])
+            _records_equal(decode_records(res["trimmed"]),
+                           _job_slice(ref.trimmed, j))
+        assert _server_qc_aggregate(jobs, srv) == ref.qc
+
+    def test_kill_and_resume_replays_byte_identically(self, tmp_path):
+        """Acceptance: a drain mid-wave (the SIGTERM stand-in) journals
+        the in-flight jobs; a NEW server with resume=True replays the
+        completed buckets from the checkpoint journal and finishes the
+        rest — final outputs and QC aggregate byte-identical to an
+        uninterrupted batch run. Device engine: every job spans two
+        length buckets, so no job completes before the kill."""
+        rng = np.random.default_rng(53)
+        G = 2000
+        genome = rng.integers(0, 4, G).astype(np.int8)
+
+        def noisy(src):
+            out = []
+            for base in src:
+                u = rng.random()
+                if u < 0.04:
+                    out.append(int(rng.integers(0, 4)))
+                    out.append(int(base))
+                elif u < 0.06:
+                    continue
+                elif u < 0.08:
+                    out.append(int((base + 1) % 4))
+                else:
+                    out.append(int(base))
+            return decode_codes(np.array(out, np.int8))
+
+        class _J:
+            def __init__(self, jid, tenant, records):
+                self.job_id, self.tenant, self.mode = jid, tenant, "clr"
+                self.records = records
+
+        jobs = []
+        for k in range(3):
+            recs = []
+            for li, ln in ((0, 300), (1, 900)):     # spans 2 buckets
+                a = int(rng.integers(0, G - ln))
+                recs.append(SeqRecord(f"j{k}/r{li}",
+                                      noisy(genome[a:a + ln])))
+            jobs.append(_J(f"job-{k}", f"t{k % 2}", recs))
+        shorts = []
+        for i in range(40):
+            st = int(rng.integers(0, G - 100))
+            seq = genome[st:st + 100].copy()
+            if rng.random() < 0.5:
+                seq = revcomp_codes(seq)
+            shorts.append(SeqRecord(f"s{i}", decode_codes(seq),
+                                    qual=np.full(100, 30, np.uint8)))
+
+        cfg = _pcfg(engine="device", device_chunk=128)
+        union = [r for j in jobs for r in j.records]
+        ref = _batch_reference(union, shorts, cfg)
+
+        state = str(tmp_path / "state")
+        srv1 = CorrectionServer(shorts, ServeConfig(
+            state_dir=state, qc=True, max_wave_jobs=8,
+            drain_after_buckets=1), cfg)
+        for j in jobs:
+            assert _submit(srv1, j)["status"] == "accepted"
+        while srv1.pump():
+            pass
+        # the drain landed mid-wave: nobody finished, everyone journaled
+        snap = srv1.slo_snapshot()
+        assert snap["jobs"]["journaled"] == 3, snap["jobs"]
+        assert snap["drain"]["requested"]
+        del srv1
+
+        srv2 = CorrectionServer(shorts, ServeConfig(
+            state_dir=state, qc=True, max_wave_jobs=8, resume=True), cfg)
+        while srv2.pump():
+            pass
+        # the first bucket REPLAYED from the checkpoint journal — the
+        # resume did not silently recompute everything
+        assert srv2.registry.counter("checkpoint_journal_replays",
+                                     "buckets").value() >= 1
+        for j in jobs:
+            res = srv2.handle({"op": "result", "job_id": j.job_id})
+            assert res["ok"], res
+            _records_equal(decode_records(res["untrimmed"]),
+                           [r for r in ref.untrimmed
+                            if r.id in {x.id for x in j.records}])
+            _records_equal(decode_records(res["trimmed"]),
+                           _job_slice(ref.trimmed, j))
+        assert _server_qc_aggregate(jobs, srv2) == ref.qc
+        from proovread_tpu.obs.validate import validate_slo
+        slo = tmp_path / "slo2.json"
+        srv2.write_slo(str(slo))
+        stats = validate_slo(str(slo))
+        assert stats["jobs"]["completed"] == 3
+        assert stats["jobs"]["journaled"] == 0
