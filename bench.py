@@ -10,6 +10,12 @@ Configs (``--config N``, mirroring BASELINE.json's ladder):
      both strands), 30x Illumina-profile SR. Sized so a single tunneled
      v5e chip exercises the streaming/bucketed regime the reference runs
      at 315 Mb scale (README.org:253-257) while the bench stays minutes.
+  4  CI-scale simulated slice: 10 kb genome, ~40 kb of long reads, fully
+     self-contained (no /root/reference needed) and small enough to run
+     on CPU interpret-mode Pallas in minutes — the before/after vehicle
+     for perf PRs developed off-chip. Rows carry a "backend" field and
+     the regression gate pools baselines per (config, backend), so CPU
+     rows never get compared against chip rows.
 
 What is timed: full ``Pipeline.run`` — mapping + consensus iterations,
 device HCR masking, mask shortcut, finish pass with chimera detection,
@@ -100,8 +106,84 @@ def _ecoli_class_workload():
     return longs, srs, truth, 6
 
 
+def _ci_scale_workload():
+    from proovread_tpu.io.simulate import (random_genome, simulate_long_reads,
+                                           simulate_short_reads)
+
+    genome = random_genome(10_000, seed=0)
+    longs, truths = simulate_long_reads(genome, 40_000, seed=1)
+    srs = simulate_short_reads(genome, 30.0, seed=2)
+    truth = {rec.id: t for rec, t in zip(longs, truths)}
+    return longs, srs, truth, 4
+
+
 def _log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _bsw_microbench(R=2048, m=112, S=2048, B=4, Lp=4096, seed=0):
+    """Standalone bsw kernel-rate probe: us per candidate through the
+    kernel the production scanned path actually uses (v2 gather-free
+    when wired, else v1 + the XLA slab gathers it cannot run without).
+    The fused path nests bsw inside one XLA program, so the per-kernel
+    attribution carries no standalone bsw entry — this probe supplies
+    the `bsw_us_per_candidate` headline PERF.md's candidates/s
+    arithmetic is stated in. On TPU it times the real Mosaic kernel;
+    on CPU it times interpret mode (a correctness vehicle, not a rate
+    statement — the row says which via "interpret")."""
+    import jax
+    import jax.numpy as jnp
+
+    from proovread_tpu.align import bsw
+    from proovread_tpu.align.params import AlignParams
+    from proovread_tpu.pipeline import dcorrect
+
+    P = AlignParams()
+    W = bsw.band_lanes(P)
+    n = m + W
+    interpret = bsw.default_interpret()
+    v2 = dcorrect.SCANNED_BSW_KERNEL == "bsw_expand_v2"
+    rng = np.random.default_rng(seed)
+    qf = jnp.asarray(rng.integers(0, 5, (S, m)).astype(np.int8))
+    rc = jnp.asarray(rng.integers(0, 5, (S, m)).astype(np.int8))
+    qlen = jnp.asarray(rng.integers(m // 2, m + 1, S).astype(np.int32))
+    map2 = jnp.asarray(rng.integers(0, 5, (B, Lp)).astype(np.int8))
+    sread = jnp.asarray(rng.integers(0, S, R).astype(np.int32))
+    strand = jnp.asarray(rng.integers(0, 2, R).astype(np.int32))
+    lread = jnp.asarray(np.sort(rng.integers(0, B, R)).astype(np.int32))
+    diag = jnp.asarray(rng.integers(0, Lp, R).astype(np.int32))
+
+    if v2:
+        map_pad = bsw.build_map_pad(map2, None, n)
+        _, w0p = bsw.window_starts(diag, W, Lp, n)
+        qlen_r = qlen[sread]
+
+        def run():
+            return bsw.bsw_expand_v2(qf, rc, map_pad, qlen_r, sread,
+                                     strand, lread, w0p, P,
+                                     interpret=interpret)
+    else:
+        @jax.jit
+        def run():
+            q = jnp.where((strand == 0)[:, None], qf[sread], rc[sread])
+            win_start = (diag - W // 2) & ~15
+            idx = win_start[:, None] + jnp.arange(n)
+            inb = (idx >= 0) & (idx < Lp)
+            flat = lread[:, None] * Lp + jnp.clip(idx, 0, Lp - 1)
+            win = jnp.where(inb, map2.reshape(-1)[flat], np.int8(4))
+            return bsw.bsw_expand(q, win, qlen[sread], P,
+                                  interpret=interpret)
+
+    jax.block_until_ready(run())
+    best = None
+    for _ in range(3):
+        t0 = time.monotonic()
+        jax.block_until_ready(run())
+        dt = time.monotonic() - t0
+        best = dt if best is None else min(best, dt)
+    return {"us_per_candidate": round(best * 1e6 / R, 3),
+            "kernel": "bsw_expand_v2" if v2 else "bsw_expand",
+            "n_candidates": R, "interpret": interpret}
 
 
 # attribution collected so far by _bench_config: a wall-budget timeout
@@ -167,7 +249,7 @@ def _retry(fn, what, tries=4):
             time.sleep(wait)
 
 
-def _bench_config(config: int) -> dict:
+def _bench_config(config: int, timed_runs: int = 3) -> dict:
     from proovread_tpu.ops.encode import encode_ascii
     from proovread_tpu.pipeline import Pipeline, PipelineConfig
 
@@ -178,6 +260,8 @@ def _bench_config(config: int) -> dict:
         longs, srs, truth, n_it = _fantasticus_workload(6)
     elif config == 2:
         longs, srs, truth, n_it = _fantasticus_workload(3)
+    elif config == 4:
+        longs, srs, truth, n_it = _ci_scale_workload()
     else:
         longs, srs, truth, n_it = _ecoli_class_workload()
     total_bases = sum(len(r) for r in longs)
@@ -192,8 +276,8 @@ def _bench_config(config: int) -> dict:
     _retry(run_once, "warm-up")
     times = []
     res = None
-    for k in range(3):
-        _log(f"timed run {k + 1}/3")
+    for k in range(timed_runs):
+        _log(f"timed run {k + 1}/{timed_runs}")
         t0 = time.monotonic()
         res = _retry(run_once, f"timed run {k + 1}")
         times.append(time.monotonic() - t0)
@@ -208,13 +292,14 @@ def _bench_config(config: int) -> dict:
     # flops/bytes/peak via Compiled.cost_analysis — docs/OBSERVABILITY.md)
     # and the span-boundary memory sampler.
     phases = n_compiles = compile_s = kernels = peak_live = None
+    res_attr = None
     try:
         from proovread_tpu import obs
         _log("traced attribution run (per-phase + per-kernel breakdown)")
         try:
             with obs.tracing() as tr, obs.profiling() as prof:
                 mem = obs.memory.install()
-                _retry(run_once, "attribution run")
+                res_attr = _retry(run_once, "attribution run")
         finally:
             obs.memory.uninstall()
         phases = _ATTRIB["phases"] = tr.phase_totals()
@@ -281,11 +366,41 @@ def _bench_config(config: int) -> dict:
         id_before = round(float(np.mean(true_identity(pairs_before))), 4)
         id_after = round(float(np.mean(true_identity(pairs_after))), 4)
 
+    # bsw throughput headline (PERF.md attack plan #2): kernel exec
+    # seconds over candidate slots actually aligned — the number the
+    # "~1.2 M candidates/s through bsw" arithmetic is stated in
+    bsw_us = bsw_probe = None
+    try:
+        n_cand_total = sum(r.n_candidates for r in res_attr.reports)
+        bsw_exec = sum((k.get("exec_s") or 0.0)
+                       for name, k in (kernels or {}).items()
+                       if name.startswith("bsw_expand"))
+        if n_cand_total and bsw_exec:
+            bsw_us = round(bsw_exec * 1e6 / n_cand_total, 3)
+            _log(f"bsw: {bsw_exec:.3f}s exec / {n_cand_total} candidates "
+                 f"-> {bsw_us} us/candidate")
+    except Exception:                                       # noqa: BLE001
+        pass    # attribution run failed earlier; fall through to the probe
+    if bsw_us is None:
+        try:
+            _log("bsw rate probe (standalone kernel microbench)")
+            bsw_probe = _bsw_microbench()
+            bsw_us = bsw_probe["us_per_candidate"]
+            _log(f"bsw: {bsw_probe['kernel']} -> {bsw_us} us/candidate"
+                 + (" [interpret]" if bsw_probe["interpret"] else ""))
+        except Exception as e:                              # noqa: BLE001
+            _log(f"bsw rate probe failed ({type(e).__name__}); "
+                 "row records null")
+
+    import jax
     return {
         "metric": "corrected_bases_per_sec_per_chip",
         "value": round(bases_per_sec, 1),
         "unit": "bases/sec/chip",
         "vs_baseline": round(bases_per_sec / BASELINE_BASES_PER_SEC, 3),
+        "backend": jax.default_backend(),
+        "bsw_us_per_candidate": bsw_us,
+        "bsw_probe": bsw_probe,
         "config": config,
         "wall_s": round(dt, 2),
         "n_reads": len(longs),
@@ -313,7 +428,7 @@ def _bench_config(config: int) -> dict:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, default=3, choices=(1, 2, 3))
+    ap.add_argument("--config", type=int, default=3, choices=(1, 2, 3, 4))
     ap.add_argument("--no-fallback", action="store_true",
                     help="fail instead of falling back to config 1")
     ap.add_argument("--wall-budget", type=float, default=3300.0,
@@ -322,6 +437,18 @@ def main():
                          "(VERDICT top_next: on breach the bench records "
                          "a partial row with \"timeout\": true instead of "
                          "dying with no BENCH entry; 0 disables)")
+    def _pos_int(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError("--timed-runs must be >= 1")
+        return n
+
+    ap.add_argument("--timed-runs", type=_pos_int, default=3, metavar="N",
+                    help="timed pipeline runs to take the median over "
+                         "(default 3; CI-scale CPU captures use 1 — "
+                         "interpret-mode runs are minutes each and the "
+                         "regression gate's thresholds absorb "
+                         "single-run noise)")
     args = ap.parse_args()
 
     # driver task lines on stderr: a failing run must show which stage/
@@ -333,7 +460,11 @@ def main():
 
     import jax
     # persistent compile cache: steady-state numbers, not XLA compile time
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    # (per backend — the CPU cache is the one the test suite keeps warm)
+    jax.config.update("jax_compilation_cache_dir",
+                      "/root/repo/.jax_cache_cpu"
+                      if jax.default_backend() == "cpu"
+                      else "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     # name every compile on stderr: when the tunneled compile helper dies,
     # the log shows WHICH program killed it — but ONLY the one 'Compiling
@@ -355,8 +486,10 @@ def main():
         # schema-valid timeout row (obs/regress.py skips it as unusable
         # but still reports it): carries whatever phase/cost attribution
         # the config collected before the budget fired
+        import jax
         row = {"metric": "corrected_bases_per_sec_per_chip",
                "value": None, "unit": "bases/sec/chip",
+               "backend": jax.default_backend(),
                "config": config, "timeout": True,
                "wall_s": round(time.monotonic() - t_start, 2),
                "timeout_error": (str(err).splitlines() or [""])[0][:300],
@@ -372,13 +505,16 @@ def main():
         with soft_deadline(args.wall_budget,
                            what=f"bench config {args.config}",
                            exc=WallClockExceeded):
-            out = _bench_config(args.config)
+            out = _bench_config(args.config, timed_runs=args.timed_runs)
     except WallClockExceeded as e:
         _log(f"config {args.config} blew the {args.wall_budget:.0f}s wall "
              "budget; recording a partial result row")
         out = _partial(args.config, e)
     except Exception as e:                                  # noqa: BLE001
-        if args.no_fallback or args.config == 1:
+        if args.no_fallback or args.config in (1, 4):
+            # config 4 is already the minimal self-contained workload —
+            # falling back to the F.antasticus sample would just fail
+            # again on machines without /root/reference
             raise
         # the bench must never exit rc=1 without a number: record the
         # failure and fall back to the small validated config

@@ -37,9 +37,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 from proovread_tpu.align.params import AlignParams
 from proovread_tpu.obs import profile as obs_profile
-from proovread_tpu.ops.encode import GAP
+from proovread_tpu.ops.encode import GAP, N
 
 NEG = np.float32(-1e9)
+
+# combined map word for bsw_expand_v2: base code in bits 0-2, MCR-ignore
+# flag in bit 3 — one window DMA carries both, and the pad value N (ignore
+# clear) decodes exactly like the XLA path's out-of-bounds mask
+MAP_IGNORE_BIT = np.int8(8)
 
 # dirs word layout (int32 per cell)
 #   bits 0-1: H' source: 0 = M starting the alignment, 1 = M continuing, 2 = F
@@ -84,9 +89,15 @@ def _extract(slab, onehot, fill):
     return jnp.max(jnp.where(onehot, slab, fill), axis=0, keepdims=True)
 
 
-def _bsw_kernel(qlen_ref, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
-                insb0_ref, insb1_ref, stats_ref, dirs_ref,
-                *, m, W, C, p: AlignParams):
+def _bsw_core(qlen, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
+              insb0_ref, insb1_ref, stats_ref, dirs_ref,
+              *, m, W, C, p: AlignParams):
+    """Banded DP + traceback over transposed VMEM blocks/scratch.
+
+    ``qlen`` is a [1, C] i32 value; ``q_ref`` [m, C] / ``win_ref`` [n, C]
+    may be pipeline block refs (v1) or DMA-filled scratch (v2). Window
+    words are masked ``& 7`` on read: v1 passes plain codes (0-4, identity)
+    while v2 packs the MCR-ignore flag in bit 3 of the same word."""
     n = m + W
     match = jnp.float32(p.match)
     mismatch = jnp.float32(p.mismatch)
@@ -97,13 +108,12 @@ def _bsw_kernel(qlen_ref, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
 
     iota_w = jax.lax.broadcasted_iota(jnp.int32, (W, C), 0)
     iota_wf = iota_w.astype(jnp.float32)
-    qlen = qlen_ref[0:1, :]                       # [1, C] i32
 
     # ---------------- forward banded DP ----------------
     def fwd(r, carry):
         h_prev, f_prev, best, best_pay = carry
         qr = q_ref[r, :][None, :]                 # [1, C] i32
-        wslab = win_ref[pl.ds(r, W), :]           # [W, C] i32
+        wslab = win_ref[pl.ds(r, W), :] & 7       # [W, C] i32 (code field)
         ambig = (qr > 3) | (wslab > 3)
         sub = jnp.where(ambig, -n_pen,
                         jnp.where(wslab == qr, match, -mismatch))
@@ -250,6 +260,89 @@ def _bsw_kernel(qlen_ref, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
     stats_ref[5:6, :] = valid.astype(jnp.float32)
 
 
+def _bsw_kernel(qlen_ref, q_ref, win_ref, state_ref, qrow_ref, inslen_ref,
+                insb0_ref, insb1_ref, stats_ref, dirs_ref,
+                *, m, W, C, p: AlignParams):
+    """v1: query/window slabs arrive pre-gathered as pipeline blocks."""
+    _bsw_core(qlen_ref[0:1, :], q_ref, win_ref, state_ref, qrow_ref,
+              inslen_ref, insb0_ref, insb1_ref, stats_ref, dirs_ref,
+              m=m, W=W, C=C, p=p)
+
+
+def _bsw_v2_kernel(sread_ref, strand_ref, lread_ref, w0_ref,
+                   qlen_ref, qf_hbm, qr_hbm, map_hbm,
+                   state_ref, qrow_ref, inslen_ref, insb0_ref, insb1_ref,
+                   stats_ref,
+                   dirs_ref, qstage_ref, wstage_ref, qT_ref, winT_ref, sem,
+                   *, m, W, C, p: AlignParams):
+    """v2: gather-free. Candidate metadata arrives as scalar prefetch and
+    the kernel DMAs its own operands from the HBM-resident packed arrays —
+    query rows from the strand-selected code array, window slices from the
+    padded combined map (code in bits 0-2, MCR-ignore in bit 3; the pad
+    regions are plain N so out-of-range window tails decode exactly like
+    the XLA path's bounds mask). The staging runs as two fori_loops —
+    one issuing all 2C starts, one draining the waits — so every copy is
+    in flight before the first wait: the issue cost (~0.1 us each) is
+    what bounds the stall, not 2C serialized DMA latencies, and the
+    program stays O(1) in C instead of unrolling 3C copy ops per grid
+    step (which made interpret-mode programs balloon). The copies share
+    one byte-counting semaphore and per-candidate sizes are fixed, so
+    the wait loop reconstructs same-shape descriptors (the guide's
+    get_dma(...).wait() idiom) and drains whichever strand's copy
+    actually ran."""
+    n = m + W
+    base = pl.program_id(0) * C
+
+    def _stage_starts(k, carry):
+        s = sread_ref[base + k]
+        dst = qstage_ref.at[pl.ds(k, 1), :]
+        cp_f = pltpu.make_async_copy(qf_hbm.at[pl.ds(s, 1), :], dst, sem)
+        cp_r = pltpu.make_async_copy(qr_hbm.at[pl.ds(s, 1), :], dst, sem)
+        fwd = strand_ref[base + k] == 0
+
+        @pl.when(fwd)
+        def _():
+            cp_f.start()
+
+        @pl.when(~fwd)
+        def _():
+            cp_r.start()
+
+        b = lread_ref[base + k]
+        w0 = pl.multiple_of(w0_ref[base + k], 16)
+        pltpu.make_async_copy(
+            map_hbm.at[pl.ds(b, 1), pl.ds(w0, n)],
+            wstage_ref.at[pl.ds(k, 1), :], sem).start()
+        return carry
+
+    def _stage_waits(k, carry):
+        pltpu.make_async_copy(
+            qf_hbm.at[pl.ds(0, 1), :],
+            qstage_ref.at[pl.ds(k, 1), :], sem).wait()
+        pltpu.make_async_copy(
+            map_hbm.at[pl.ds(0, 1), pl.ds(0, n)],
+            wstage_ref.at[pl.ds(k, 1), :], sem).wait()
+        return carry
+
+    jax.lax.fori_loop(0, C, _stage_starts, 0)
+    jax.lax.fori_loop(0, C, _stage_waits, 0)
+
+    # orient to the DP layout (candidates in lanes) in VMEM
+    qT_ref[...] = qstage_ref[...].astype(jnp.int32).T
+    winT_ref[...] = wstage_ref[...].astype(jnp.int32).T
+
+    _bsw_core(qlen_ref[0:1, :], qT_ref, winT_ref, state_ref, qrow_ref,
+              inslen_ref, insb0_ref, insb1_ref, stats_ref, dirs_ref,
+              m=m, W=W, C=C, p=p)
+
+    # MCR-ignore gating (bit 3 of the map word), applied where the XLA
+    # scanned path zeroed state/ins_len post-kernel: votes and attached
+    # insertion runs die, per-candidate stats stay untouched
+    ign = (winT_ref[...] >> 3) > 0
+    state_ref[...] = jnp.where(ign, -1, state_ref[...])
+    inslen_ref[...] = jnp.where(ign, 0, inslen_ref[...])
+
+
 def _block_candidates(m: int) -> int:
     """Candidates per kernel program, sized so dirs fits VMEM.
 
@@ -320,6 +413,128 @@ def bsw_expand(q, win, qlen, params: AlignParams,
         scratch_shapes=[pltpu.VMEM((m, W, C), jnp.int32)],
         interpret=interpret,
     )(qlen2, qT, winT)
+
+    return BswResult(
+        state=state.T, qrow=qrow.T, ins_len=inslen.T,
+        score=stats[0], q_start=stats[1].astype(jnp.int32),
+        q_end=stats[2].astype(jnp.int32), r_start=stats[3].astype(jnp.int32),
+        r_end=stats[4].astype(jnp.int32), valid=stats[5] > 0.5,
+        ins_b0=insb0.T, ins_b1=insb1.T,
+    )
+
+
+def map_pad_width(n: int) -> int:
+    """Left/right pad (columns) of the combined map array ``bsw_expand_v2``
+    windows against. Must be >= n + 16 so a fully out-of-range window
+    (win_start < -n or > L) clamps to a slice that lies entirely inside a
+    pad region (all-N, ignore bit clear — exactly what the XLA path's
+    bounds mask substituted), and a multiple of 32 so the 16-aligned
+    win_start stays 16-aligned after the +pad shift."""
+    return -(-(n + 16) // 32) * 32
+
+
+def build_map_pad(map_codes: jnp.ndarray, ignore_cols, n: int) -> jnp.ndarray:
+    """[B, Lp] map codes (+ optional bool ignore mask) -> the padded
+    combined-word array ``bsw_expand_v2`` windows against. Built ONCE per
+    pass by cheap elementwise ops — the per-chunk ``map_flat[flat_idx]``
+    gathers this replaces ran at ~10 ns/element on the scalar core."""
+    comb = map_codes
+    if ignore_cols is not None:
+        comb = comb | jnp.where(ignore_cols, MAP_IGNORE_BIT, jnp.int8(0))
+    padw = map_pad_width(n)
+    return jnp.pad(comb, ((0, 0), (padw, padw)),
+                   constant_values=np.int8(N))
+
+
+def window_starts(diag: jnp.ndarray, W: int, Lp: int, n: int):
+    """Per-candidate (win_start, padded-map w0) from the seeder diagonal.
+    win_start reproduces _gather_and_align's 16-aligned band placement;
+    w0 is clipped so fully out-of-range windows land inside a pad region
+    (see :func:`map_pad_width`) without breaking 16-alignment."""
+    win_start = (diag - W // 2) & ~15
+    padw = map_pad_width(n)
+    limit = (Lp + 2 * padw - n) & ~15
+    w0p = jnp.clip(win_start + padw, 0, limit)
+    return win_start, w0p
+
+
+@obs_profile.attributed("bsw_expand_v2")
+@functools.partial(jax.jit, static_argnames=("params", "interpret"))
+def bsw_expand_v2(q_hbm, rc_hbm, map_pad, qlen, sread, strand, lread, w0p,
+                  params: AlignParams, interpret: bool = False) -> BswResult:
+    """Gather-free twin of :func:`bsw_expand` (PERF.md attack plan #2).
+
+    Instead of XLA materializing ``q_codes[sread]`` / window slabs at
+    ~10 ns/element on the scalar core, the kernel DMAs its own operands:
+
+    q_hbm:   i8 [S, m] packed query codes (forward), HBM-resident
+    rc_hbm:  i8 [S, m] revcomp'd codes, left-aligned (same layout as the
+             ``rc_codes`` the XLA path indexed)
+    map_pad: i8 [B, Lp + 2*map_pad_width(n)] combined map words — code in
+             bits 0-2, MCR-ignore flag in bit 3, pad columns = N
+    qlen:    i32 [R] per-candidate query length (q_lengths[sread], one [R]
+             gather hoisted OUT of the chunk loop by the caller)
+    sread/strand/lread: i32 [R] candidate metadata (scalar prefetch)
+    w0p:     i32 [R] 16-aligned window start in padded map coords, clipped
+             to [0, (Lpad - n) & ~15]
+
+    Output is bitwise-identical to bsw_expand on the XLA-gathered slabs
+    with the scanned path's post-kernel ignore gating applied (state -> -1,
+    ins_len -> 0 on ignored columns); v1 stays in-tree as the equivalence
+    oracle (tests/test_device_path.py::TestBswV2Equivalence)."""
+    S, m = q_hbm.shape
+    R = sread.shape[0]
+    W = band_lanes(params)
+    assert W <= 128, f"band_lanes({params.band_width}) = {W} > 128 lanes"
+    n = m + W
+    assert rc_hbm.shape == (S, m), (rc_hbm.shape, (S, m))
+    assert map_pad.shape[1] >= n + 2 * 16, map_pad.shape
+    C = _block_candidates(m)
+    assert R % C == 0, (R, C)
+
+    qlen2 = qlen.astype(jnp.int32)[None, :]        # [1, R]
+    kernel = functools.partial(_bsw_v2_kernel, m=m, W=W, C=C, p=params)
+    grid = (R // C,)
+    out_block = pl.BlockSpec((n, C), lambda i, *_: (0, i),
+                             memory_space=pltpu.VMEM)
+    state, qrow, inslen, insb0, insb1, stats = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, C), lambda i, *_: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                out_block, out_block, out_block, out_block, out_block,
+                pl.BlockSpec((8, C), lambda i, *_: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((m, W, C), jnp.int32),      # dirs
+                pltpu.VMEM((C, m), jnp.int8),          # query staging
+                pltpu.VMEM((C, n), jnp.int8),          # window staging
+                pltpu.VMEM((m, C), jnp.int32),         # qT
+                pltpu.VMEM((n, C), jnp.int32),         # winT
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
+            jax.ShapeDtypeStruct((n, R), jnp.int32),
+            jax.ShapeDtypeStruct((8, R), jnp.float32),
+        ],
+        interpret=interpret,
+    )(sread.astype(jnp.int32), strand.astype(jnp.int32),
+      lread.astype(jnp.int32), w0p.astype(jnp.int32),
+      qlen2, q_hbm, rc_hbm, map_pad)
 
     return BswResult(
         state=state.T, qrow=qrow.T, ins_len=inslen.T,

@@ -8,7 +8,9 @@ per-phase ``"phases"`` breakdown and now (PR 4) per-kernel
 
 - loads the history (wrapper objects or bare bench rows, one per file or
   JSON-lines),
-- takes the newest **usable** row (parsed, non-timeout, same config) and
+- takes the newest **usable** row (parsed, non-timeout, same config AND
+  same backend — CPU interpret-mode rows never regress against chip
+  rows) and
   a rolling baseline of the previous usable rows,
 - computes deltas for the headline bases/sec, the wall time, and each
   span phase's ``total_s``,
@@ -93,8 +95,12 @@ def _usable(entry: Dict[str, Any]) -> bool:
             and not row.get("timeout"))
 
 
-def _config_of(row: Dict[str, Any]) -> int:
-    return int(row.get("config", 1))
+def _pool_key(row: Dict[str, Any]):
+    """Rows are only comparable within the same (config, backend): a CPU
+    interpret-mode row regressing against a chip row (or vice versa) would
+    measure the machine, not the change. Legacy rows predate the
+    ``backend`` field and were all recorded on the tunneled TPU."""
+    return (int(row.get("config", 1)), row.get("backend") or "tpu")
 
 
 def _median(vals: List[float]) -> float:
@@ -127,13 +133,13 @@ def perf_check(entries: List[Dict[str, Any]],
                 "latest": None, "baseline_rounds": [], "checks": checks}
 
     latest = usable[-1]
-    cfg = _config_of(latest["row"])
+    key = _pool_key(latest["row"])
     pool = [e for e in usable[:-1]
-            if _config_of(e["row"]) == cfg][-window:]
+            if _pool_key(e["row"]) == key][-window:]
     if not pool:
         checks.append({"check": "baseline", "status": "skipped",
-                       "note": f"no prior usable rows at config {cfg} — "
-                               "nothing to regress against"})
+                       "note": f"no prior usable rows at config/backend "
+                               f"{key} — nothing to regress against"})
         verdict = "PASS"
         return {"schema": SCHEMA_VERSION, "verdict": verdict,
                 "latest": latest["source"], "baseline_rounds":

@@ -83,7 +83,7 @@ def sharded_iteration_step(
         n_cand = jnp.minimum(n_valid, R_need).astype(jnp.int32)
 
         call, n_admitted, _n_eligible, _, _, _ = _fused_pass_body(
-            map_codes.reshape(-1), mask_cols.reshape(-1),
+            map_codes, mask_cols,
             codes, qual, lengths, qc, rcq, qq, qlen,
             sread, strand, lread, diag, n_cand,
             m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,

@@ -684,7 +684,7 @@ def _gather_and_align(map_flat, q_codes, rc_codes, q_qual, q_lengths,
     return res, q, qual, win_start, passed, pos0, span, ignore_cols
 
 
-def _fused_pass_unrolled(map_flat, ignore_flat, codes, qual, lengths,
+def _fused_pass_unrolled(map_codes2, ignore_cols2, codes, qual, lengths,
                          q_codes, rc_codes, q_qual, q_lengths,
                          sread, strand, lread, diag, n_cand,
                          m: int, W: int, CH: int, n_chunks: int,
@@ -695,11 +695,21 @@ def _fused_pass_unrolled(map_flat, ignore_flat, codes, qual, lengths,
     program grows with n_chunks and its compile time explodes past ~16
     chunks; the mainline unweighted path is :func:`_fused_pass_scanned`).
 
+    This path keeps the XLA-gathered v1 kernel (build_votes needs the
+    query/qual slabs in flight anyway) and doubles as the equivalence
+    oracle for the gather-free scanned path. The [B, Lp] -> [B*Lp]
+    flatten happens ONCE here and the flat view is threaded through every
+    chunk's _gather_and_align — XLA used to re-materialize the relayout
+    per consumer (5.7 ms x chunk count, PERF.md).
+
     The sub-ops (bsw kernel, vote packing, pileup scatter, consensus call)
     each run in well under a millisecond on the chip; dispatched one by one
     through the tunneled runtime, the pass was dispatch-bound at ~300ms per
     chunk. Tracing the whole chunk loop + admission + consensus into one
     jit collapses that to a single dispatch."""
+    map_flat = map_codes2.reshape(-1)
+    ignore_flat = (None if ignore_cols2 is None
+                   else ignore_cols2.reshape(-1))
     B, Lp = codes.shape
     n = m + W
     pad = n
@@ -835,7 +845,14 @@ def _fused_pass_unrolled(map_flat, ignore_flat, codes, qual, lengths,
     return call, n_admitted, n_eligible, scalars, slabs, hpl
 
 
-def _fused_pass_scanned(map_flat, ignore_flat, codes, qual, lengths,
+# which bsw entry point the scanned chunk loop aligns with — bench.py's
+# standalone rate probe keys off this so BENCH rows always measure the
+# kernel production actually runs (a source-text probe would match
+# docstrings)
+SCANNED_BSW_KERNEL = "bsw_expand_v2"
+
+
+def _fused_pass_scanned(map_codes2, ignore_cols2, codes, qual, lengths,
                         q_codes, rc_codes, q_qual, q_lengths,
                         sread, strand, lread, diag, n_cand,
                         m: int, W: int, CH: int, n_chunks: int,
@@ -851,7 +868,17 @@ def _fused_pass_scanned(map_flat, ignore_flat, codes, qual, lengths,
     n_chunks: scan 1 aligns each chunk and stacks compact slabs (state i8,
     qrow/ins_len i16, packed ins-base words) in HBM, admission runs
     globally over the stacked stats, and scan 2 encodes votes and feeds the
-    blocked pileup kernel with the pileup buffer as the scan carry."""
+    blocked pileup kernel with the pileup buffer as the scan carry.
+
+    Since bsw v2 the chunk loop is GATHER-FREE (PERF.md attack plan #2):
+    the kernel DMAs its own query rows and map windows from HBM via
+    scalar-prefetched candidate metadata, applies the MCR-ignore gating
+    in-kernel (bit 3 of the combined map word), and emits the packed
+    inserted-base words encode_votes_packed_bases consumes — so neither
+    scan body contains a single XLA gather (guarded by
+    tests/test_no_gather.py). The only index-typed ops left per pass are
+    the [R]-element qlen row gather hoisted out of the scan and the
+    admission sort/searchsorted, both outside the chunk loop."""
     B, Lp = codes.shape
     n = m + W
     pad = n
@@ -863,30 +890,32 @@ def _fused_pass_scanned(map_flat, ignore_flat, codes, qual, lengths,
     def r2(x):
         return x.reshape(nc, CH)
 
-    xs = (jnp.arange(nc, dtype=jnp.int32), r2(sread),
-          r2(strand.astype(jnp.int32)), r2(lread), r2(diag))
+    # once per pass, all elementwise: the padded combined map the kernel
+    # windows against, the per-candidate window placement, and the qlen
+    # row gather ([R] elements — NOT the [R, m] slab gathers of v1)
+    map_pad = bsw.build_map_pad(map_codes2, ignore_cols2, n)
+    qlen_all = q_lengths[sread].astype(jnp.int32)
+    win_start_all, w0p_all = bsw.window_starts(diag, W, Lp, n)
 
-    def align_one(c, sread_c, strand_c, lread_c, diag_c):
+    xs = (jnp.arange(nc, dtype=jnp.int32), r2(sread),
+          r2(strand.astype(jnp.int32)), r2(lread),
+          r2(win_start_all), r2(w0p_all), r2(qlen_all))
+
+    def align_one(c, sread_c, strand_c, lread_c, ws_c, w0p_c, qlen_c):
         def live():
-            res, q, _, win_start, passed, pos0, span, ign = \
-                _gather_and_align(
-                    map_flat, q_codes, rc_codes, q_qual, q_lengths,
-                    sread_c, strand_c, lread_c, diag_c, Lp, m=m, W=W,
-                    ap=ap, ignore_flat=ignore_flat, interpret=interpret,
-                    need_qual=False)
+            res = bsw.bsw_expand_v2(
+                q_codes, rc_codes, map_pad, qlen_c, sread_c, strand_c,
+                lread_c, w0p_c, ap, interpret=interpret)
+            thr = (ap.min_out_score * qlen_c.astype(jnp.float32)
+                   if ap.score_per_base else ap.min_out_score)
+            passed = res.valid & (res.score >= thr)
             live_m = (c * CH + jnp.arange(CH, dtype=jnp.int32)) < n_cand
-            state = res.state
-            ins_len = res.ins_len
-            if ign is not None:
-                # masked ref columns are gated from all votes (col_ok in
-                # the encoder); killing the state and the attached run
-                # here reproduces that without storing the mask
-                state = jnp.where(ign, -1, state)
-                ins_len = jnp.where(ign, 0, ins_len)
-            return (state.astype(jnp.int8), res.qrow.astype(jnp.int16),
-                    ins_len.astype(jnp.int16), res.ins_b0, res.ins_b1,
+            pos0 = ws_c + res.r_start
+            span = res.r_end - res.r_start
+            return (res.state.astype(jnp.int8), res.qrow.astype(jnp.int16),
+                    res.ins_len.astype(jnp.int16), res.ins_b0, res.ins_b1,
                     res.q_start, res.q_end, res.r_start, res.r_end,
-                    win_start, passed & live_m, pos0, span, res.score)
+                    ws_c, passed & live_m, pos0, span, res.score)
 
         def dead():
             def zi(*shape):
@@ -968,19 +997,23 @@ def _fused_pass_scanned(map_flat, ignore_flat, codes, qual, lengths,
     return call, n_admitted, n_eligible, scalars, slabs, hpl
 
 
-def _fused_pass_body(map_flat, ignore_flat, codes, qual, lengths,
+def _fused_pass_body(map_codes2, ignore_cols2, codes, qual, lengths,
                      q_codes, rc_codes, q_qual, q_lengths,
                      sread, strand, lread, diag, n_cand,
                      m: int, W: int, CH: int, n_chunks: int,
                      ap: AlignParams, cns: ConsensusParams,
                      interpret: bool, collect: bool,
                      budget_r=None, haplo: bool = False):
-    """One full correction pass as a SINGLE XLA program: the scanned chunk
-    loop for the mainline unweighted path, the unrolled formulation for
-    the qual-weighted one (build_votes needs the query slabs in flight)."""
+    """One full correction pass as a SINGLE XLA program: the gather-free
+    scanned chunk loop (bsw v2) for the mainline unweighted path, the
+    unrolled v1 formulation for the qual-weighted one (build_votes needs
+    the query slabs in flight). ``map_codes2``/``ignore_cols2`` arrive as
+    [B, Lp] views — each impl decides ONCE how to lay them out (padded
+    combined map vs a single flatten) instead of every consumer paying
+    its own relayout."""
     impl = (_fused_pass_unrolled if cns.qual_weighted
             else _fused_pass_scanned)
-    return impl(map_flat, ignore_flat, codes, qual, lengths,
+    return impl(map_codes2, ignore_cols2, codes, qual, lengths,
                 q_codes, rc_codes, q_qual, q_lengths,
                 sread, strand, lread, diag, n_cand,
                 m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
@@ -1071,7 +1104,7 @@ def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
         n_drop = jnp.maximum(n_valid - R_need, 0).astype(jnp.int32)
 
         call, n_adm, n_elig, _, _, _ = _fused_pass_body(
-            map_codes.reshape(-1), mask_cols.reshape(-1),
+            map_codes, mask_cols,
             codes, qual, lengths, qc, rcq, qq, qlen,
             sread, strand, lread, diag, n_cand,
             m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
@@ -1212,10 +1245,9 @@ class DeviceCorrector:
             sp.fence(n_valid)
         n_cand = int(n_valid)                       # host sync #1
 
-        map_flat = map_codes.reshape(-1)
-        ignore_flat = None
+        ignore_cols = None
         if use_mask_as_ignore and mask_cols is not None:
-            ignore_flat = mask_cols.reshape(-1)
+            ignore_cols = mask_cols
 
         CH = self.chunk
         # bucket the chunk count: n_chunks is a static arg of the fused
@@ -1234,7 +1266,7 @@ class DeviceCorrector:
         with obs.span("consense", cat="kernel", n_cand=n_cand,
                       chunks=n_chunks) as sp:
             call, n_admitted, n_eligible, scalars, slabs, hpl = _fused_pass(
-                map_flat, ignore_flat, codes, qual, lengths,
+                map_codes, ignore_cols, codes, qual, lengths,
                 q_codes, rc_codes, q_qual, q_lengths,
                 sread, strand, lread, diag,
                 jnp.asarray(n_cand, jnp.int32),

@@ -1,0 +1,57 @@
+package Fastq::Parser;
+# Minimal Fastq::Parser for the vendored reference-consensus fallback
+# (tests/lib/README.md): slurps the file, guesses/pins the phred offset,
+# yields Fastq::Seq records.
+use strict;
+use warnings;
+use Fastq::Seq;
+
+sub new {
+    my ( $class, %args ) = @_;
+    my $self = bless { records => [], phred_offset => undef }, $class;
+    open my $fh, '<', $args{file} or die "Fastq::Parser: $args{file}: $!";
+    while ( my $hd = <$fh> ) {
+        chomp $hd;
+        next unless length $hd;
+        die "bad FASTQ header: $hd" unless $hd =~ /^@/;
+        my $seq  = <$fh>;
+        my $plus = <$fh>;
+        my $qual = <$fh>;
+        die "truncated FASTQ record" unless defined $qual;
+        chomp( $seq, $plus, $qual );
+        my ($id) = ( substr( $hd, 1 ) =~ /^(\S+)/ );
+        push @{ $self->{records} },
+            Fastq::Seq->new( id => $id, seq => $seq, qual => $qual );
+    }
+    close $fh;
+    return $self;
+}
+
+sub guess_phred_offset {
+    my ($self) = @_;
+    my $min;
+    for my $r ( @{ $self->{records} } ) {
+        for my $c ( split //, $r->qual // '' ) {
+            my $o = ord $c;
+            $min = $o if !defined $min or $o < $min;
+        }
+    }
+    return undef unless defined $min;
+    return $min < 59 ? 33 : 64;
+}
+
+sub phred_offset {
+    my ( $self, $po ) = @_;
+    if ( defined $po ) {
+        $self->{phred_offset} = $po;
+        $_->phred_offset($po) for @{ $self->{records} };
+    }
+    return $self->{phred_offset};
+}
+
+sub next_seq {
+    my ($self) = @_;
+    return shift @{ $self->{records} };
+}
+
+1;

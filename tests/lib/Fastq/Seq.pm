@@ -1,0 +1,42 @@
+package Fastq::Seq;
+# Minimal Fastq::Seq for the vendored reference-consensus fallback
+# (tests/lib/README.md). API subset used by tests/perl_cns.pl and
+# Sam::Seq: id/seq/qual accessors, phreds with a parser-pinned offset,
+# and FASTQ stringification.
+use strict;
+use warnings;
+use overload '""' => \&string, fallback => 1;
+
+sub new {
+    my ( $class, %args ) = @_;
+    return bless {
+        id           => $args{id},
+        seq          => $args{seq},
+        qual         => $args{qual},
+        phred_offset => $args{phred_offset},
+    }, $class;
+}
+
+sub id   { $_[0]{id} }
+sub seq  { $_[0]{seq} }
+sub qual { $_[0]{qual} }
+
+sub phred_offset {
+    my ( $self, $po ) = @_;
+    $self->{phred_offset} = $po if defined $po;
+    return $self->{phred_offset};
+}
+
+sub phreds {
+    my ($self) = @_;
+    my $po = $self->{phred_offset} // 33;
+    return map { ord($_) - $po } split //, $self->{qual} // '';
+}
+
+sub string {
+    my ($self) = @_;
+    return sprintf "@%s\n%s\n+\n%s\n", $self->{id}, $self->{seq},
+        $self->{qual} // '';
+}
+
+1;

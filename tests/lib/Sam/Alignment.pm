@@ -1,0 +1,69 @@
+package Sam::Alignment;
+# Minimal Sam::Alignment for the vendored reference-consensus fallback
+# (tests/lib/README.md): one SAM line -> accessors + optional-field
+# lookup. ref_span follows the reference's "length" convention for bins/
+# coverage/nscore (reference bases consumed, M/D, soft-clip branch of
+# the real Sam::Alignment:393-431); length() is the aligned query string
+# length the contained-alignment filter ranges on.
+use strict;
+use warnings;
+
+sub new {
+    my ( $class, $line ) = @_;
+    chomp $line;
+    my @f = split /\t/, $line;
+    die "bad SAM line: $line" if @f < 11;
+    my %self = (
+        qname => $f[0], flag => $f[1], rname => $f[2], pos => $f[3],
+        mapq  => $f[4], cigar => $f[5], rnext => $f[6], pnext => $f[7],
+        tlen  => $f[8], seq  => $f[9], qual => $f[10], opt => {},
+    );
+    for my $t ( @f[ 11 .. $#f ] ) {
+        my ( $tag, $type, $val ) = split /:/, $t, 3;
+        $self{opt}{$tag} = $val;
+    }
+    return bless \%self, $class;
+}
+
+sub qname { $_[0]{qname} }
+sub flag  { $_[0]{flag} }
+sub rname { $_[0]{rname} }
+sub pos   { $_[0]{pos} }
+sub mapq  { $_[0]{mapq} }
+sub cigar { $_[0]{cigar} }
+sub seq   { $_[0]{seq} }
+sub qual  { $_[0]{qual} }
+
+sub opt {
+    my ( $self, $tag ) = @_;
+    return $self->{opt}{$tag};
+}
+
+sub score { $_[0]->opt('AS') }
+
+sub length {    ## no critic (Subroutines::ProhibitBuiltinHomonyms)
+    return CORE::length( $_[0]{seq} );
+}
+
+sub cigar_ops {
+    my ($self) = @_;
+    my @out;
+    while ( $self->{cigar} =~ /(\d+)([MIDNSHP=X])/g ) {
+        my ( $ln, $op ) = ( $1, $2 );
+        $op = 'M' if $op eq '=' or $op eq 'X';
+        die "unsupported CIGAR op $op" if $op eq 'N' or $op eq 'P';
+        push @out, [ $op, $ln ];
+    }
+    return @out;
+}
+
+sub ref_span {
+    my ($self) = @_;
+    my $span = 0;
+    for my $o ( $self->cigar_ops ) {
+        $span += $o->[1] if $o->[0] eq 'M' or $o->[0] eq 'D';
+    }
+    return $span;
+}
+
+1;

@@ -1,0 +1,385 @@
+package Sam::Seq;
+# Vendored reference consensus engine — the CONSENSUS SUBSET of
+# proovread's Sam::Seq (state-matrix weighted-majority consensus per
+# Hackl et al. 2014), reimplemented in pure Perl from the reference
+# semantics the Python engine documents line-by-line
+# (proovread_tpu/consensus/{cigar,alnset,engine}.py, Sam/Seq.pm:232-467,
+# 582-614, 1001-1047, 1568-1654). It exists so the golden-parity tests
+# can run on machines without /root/reference/lib (tests/lib/README.md);
+# when the real reference library is present it shadows this module.
+#
+# Faithful to the reference where the Python engine deviates on purpose:
+# dynamic string states (composite insertion states stay distinct vote
+# candidates instead of being merged by match base), uncapped inserted-
+# base emission, and hash-order tie-breaks in the consensus vote and the
+# contained-alignment filter (the PERL_HASH_SEED envelope the utg parity
+# test measures).
+#
+# NOT implemented: call_variants / stabilize_variants (the variants
+# parity tests probe `Sam::Seq->can('call_variants')` and skip against
+# this fallback), MCR ignore coords, rep-region filters, chimera.
+use strict;
+use warnings;
+use List::Util qw(min);
+use Fastq::Seq;
+
+# -- class attributes (Sam/Seq.pm:113-128) --------------------------------
+my %Attr = (
+    Trim             => 1,
+    InDelTaboo       => 0.1,
+    InDelTabooLength => 0,
+    MaxCoverage      => 50,
+    BinSize          => 20,
+    MaxInsLength     => 0,
+    FallbackPhred    => 1,
+    PhredOffset      => 33,
+);
+
+for my $name ( keys %Attr ) {
+    no strict 'refs';
+    *{$name} = sub {
+        my ( $class, $v ) = @_;
+        $Attr{$name} = $v if defined $v;
+        return $Attr{$name};
+    };
+}
+
+sub BinMaxBases { $Attr{BinSize} * $Attr{MaxCoverage} }
+
+my $MIN_ALN_LENGTH     = 50;     # StateMatrixMinAlnLength
+my $NCSCORE_CONSTANT   = 40;     # Sam/Alignment.pm:245-247
+my $PROOVREAD_CONSTANT = 120;    # freq<->phred scale (Sam/Seq.pm:20-33)
+my $MAX_PHRED          = 40;
+
+sub phred2freq {
+    my ($p) = @_;
+    $p = 93 if $p > 93;
+    return int( ( $p * $p / $PROOVREAD_CONSTANT ) * 100 + 0.5 ) / 100;
+}
+
+sub freq2phred {
+    my ($f) = @_;
+    $f = 0 if $f < 0;
+    my $p = int( sqrt( $f * $PROOVREAD_CONSTANT ) + 0.5 );
+    return $p > $MAX_PHRED ? $MAX_PHRED : $p;
+}
+
+# -- construction ---------------------------------------------------------
+sub new {
+    my ( $class, %args ) = @_;
+    my $self = bless {
+        id       => $args{id},
+        len      => $args{len},
+        ref      => $args{ref},
+        alns     => {},          # iid -> Sam::Alignment
+        next_iid => 0,
+        bin_alns => [],          # bin -> [[ncscore, iid, span], ...]
+        bin_bases => [],
+    }, $class;
+    return $self;
+}
+
+sub id  { $_[0]{id} }
+sub len { $_[0]{len} }
+
+sub n_bins { int( $_[0]{len} / $Attr{BinSize} ) + 1 }
+
+# -- admission (Sam/Seq.pm:582-614) ---------------------------------------
+sub add_aln {
+    my ( $self, $aln ) = @_;
+    $self->{alns}{ $self->{next_iid}++ } = $aln;
+    return 1;
+}
+
+sub add_aln_by_score {
+    my ( $self, $aln ) = @_;
+    my $span = $aln->ref_span;
+    return 0 unless $span > 0;
+    my $score = $aln->score;
+    return 0 unless defined $score;
+    my $nc  = $score / ( $NCSCORE_CONSTANT + $span );
+    my $bin = int( ( $aln->pos + $span / 2 ) / $Attr{BinSize} );
+    my $nb  = $self->n_bins;
+    $bin = 0 if $bin < 0;
+    $bin = $nb - 1 if $bin >= $nb;
+
+    my $iid = $self->{next_iid}++;
+    $self->{alns}{$iid} = $aln;
+    push @{ $self->{bin_alns}[$bin] }, [ $nc, $iid, $span ];
+
+    # score-binned coverage cap: rank the bin by ncscore (desc, arrival
+    # order on ties) and keep alignments while the admitted bases BEFORE
+    # them stay within the budget — the crossing alignment is admitted
+    # too (Sam/Seq.pm:591)
+    my $budget = BinMaxBases();
+    my @ranked = sort { $b->[0] <=> $a->[0] or $a->[1] <=> $b->[1] }
+        @{ $self->{bin_alns}[$bin] };
+    my ( $cum, @keep ) = (0);
+    for my $e (@ranked) {
+        if ( $cum <= $budget ) { push @keep, $e; }
+        else                   { delete $self->{alns}{ $e->[1] }; }
+        $cum += $e->[2];
+    }
+    $self->{bin_alns}[$bin] = \@keep;
+    $self->{bin_bases}[$bin] = 0;
+    $self->{bin_bases}[$bin] += $_->[2] for @keep;
+    return exists $self->{alns}{$iid};
+}
+
+# -- contained-alignment filter (Sam/Seq.pm:1001-1047) --------------------
+sub _in_range {
+    my ( $c, $coords ) = @_;
+    my ( $c1, $c2 ) = ( $c->[0], $c->[0] + $c->[1] - 1 );
+    for my $r (@$coords) {
+        return 1
+            if  $r->[0] <= $c1
+            and $c1 < $r->[0] + $r->[1]
+            and $r->[0] <= $c2
+            and $c2 < $r->[0] + $r->[1];
+    }
+    return 0;
+}
+
+sub filter_contained_alns {
+    my ($self) = @_;
+    my $alns = $self->{alns};
+    # queue sorted by aligned query length desc; `keys %$alns` hash order
+    # feeds the sort ties (Sam/Seq.pm:1006) — the reference's documented
+    # PERL_HASH_SEED nondeterminism
+    my @ids = sort {
+        $alns->{$b}->length <=> $alns->{$a}->length
+    } keys %$alns;
+    my @coords = map { [ $alns->{$_}->pos - 1, $alns->{$_}->length ] } @ids;
+    my @scores = map { $alns->{$_}->score // 0 } @ids;
+    my %removed;
+    while ( @ids > 1 ) {
+        my $iid = pop @ids;
+        my $coo = pop @coords;
+        if ( $coo->[1] < 21 ) {
+            $coo = [ $coo->[0] + int( $coo->[1] / 2 ), 1 ];
+        }
+        else {
+            my $ad = int( $coo->[1] * 0.1 );
+            $coo = [ $coo->[0] + $ad, $coo->[1] - 2 * $ad ];
+        }
+        if ( _in_range( $coo, \@coords ) ) {
+            if ( $coo->[1] > $coords[-1][1] - 40 ) {
+                # near-identical length: keep the better-scoring one
+                my $i = scalar @coords;
+                if ( $scores[$i] > $scores[ $i - 1 ] ) {
+                    my $iid_restore = $iid;
+                    $iid = pop @ids;
+                    pop @coords;
+                    push @ids,    $iid_restore;
+                    push @coords, $coo;
+                }
+            }
+            $removed{$iid} = 1;
+        }
+    }
+    delete $alns->{$_} for keys %removed;
+    return scalar keys %removed;
+}
+
+# -- state matrix (Sam/Seq.pm:232-467) ------------------------------------
+sub _aln_phreds {
+    my ( $self, $aln ) = @_;
+    my $q = $aln->qual;
+    if ( !defined $q or $q eq '*' ) {
+        return [ ( $Attr{FallbackPhred} ) x CORE::length( $aln->seq ) ];
+    }
+    my $po = $Attr{PhredOffset};
+    return [ map { ord($_) - $po } split //, $q ];
+}
+
+sub _expand_aln {
+    my ( $self, $aln ) = @_;
+    my @ops = $aln->cigar_ops;
+    return undef unless @ops;
+    my $seq  = uc $aln->seq;
+    my $ph   = $self->_aln_phreds($aln);
+    my $rpos = $aln->pos - 1;
+
+    # strip clips: S consumes query, H is annotation only (:290-310)
+    if ( $ops[0][0] eq 'S' ) {
+        substr( $seq, 0, $ops[0][1] ) = '';
+        splice @$ph, 0, $ops[0][1];
+        shift @ops;
+    }
+    if ( @ops and $ops[-1][0] eq 'S' ) {
+        substr( $seq, -$ops[-1][1] ) = '';
+        splice @$ph, -$ops[-1][1];
+        pop @ops;
+    }
+    shift @ops if @ops and $ops[0][0]  eq 'H';
+    pop @ops   if @ops and $ops[-1][0] eq 'H';
+    die "empty CIGAR after clip strip" unless @ops;
+
+    my $orig_len = CORE::length($seq);
+    return undef if $orig_len <= $MIN_ALN_LENGTH;
+
+    if ( $Attr{Trim} ) {
+        my $taboo = $Attr{InDelTabooLength}
+            ? $Attr{InDelTabooLength}
+            : int( $orig_len * $Attr{InDelTaboo} + 0.5 );
+
+        # head: advance to the first M run crossing the taboo boundary
+        # and cut everything before it (:318-350)
+        my ( $mc, $dc, $ic ) = ( 0, 0, 0 );
+        for my $i ( 0 .. $#ops ) {
+            my ( $op, $ln ) = @{ $ops[$i] };
+            if ( $op eq 'M' ) {
+                if ( $mc + $ic + $ln > $taboo ) {
+                    if ($i) {
+                        $rpos += $mc + $dc;
+                        substr( $seq, 0, $mc + $ic ) = '';
+                        splice @$ph, 0, $mc + $ic;
+                        splice @ops, 0, $i;
+                    }
+                    last;
+                }
+                $mc += $ln;
+            }
+            elsif ( $op eq 'D' ) { $dc += $ln; }
+            elsif ( $op eq 'I' ) { $ic += $ln; }
+            else { die "unexpected CIGAR op $op after clip strip"; }
+        }
+        return undef
+            if CORE::length($seq) < $MIN_ALN_LENGTH
+            or CORE::length($seq) / $orig_len < 0.7;
+
+        # tail: mirror pass; the first op is never a cut point (:358)
+        my $tail = 0;
+        for ( my $i = $#ops; $i >= 1; $i-- ) {
+            my ( $op, $ln ) = @{ $ops[$i] };
+            if ( $op eq 'M' ) {
+                $tail += $ln;
+                if ( $tail > $taboo ) {
+                    if ( $i < $#ops ) {
+                        my $tail_cut = $tail - $ln;
+                        splice @ops, $i + 1;
+                        if ( $tail_cut > 0 ) {
+                            substr( $seq, -$tail_cut ) = '';
+                            splice @$ph, -$tail_cut;
+                        }
+                    }
+                    last;
+                }
+            }
+            elsif ( $op eq 'I' ) { $tail += $ln; }
+        }
+        return undef
+            if CORE::length($seq) < $MIN_ALN_LENGTH
+            or CORE::length($seq) / $orig_len < 0.7;
+    }
+
+    # CIGAR -> per-reference-column state strings; insertions attach to
+    # the preceding column as composite states, with the bowtie2 1D1I ->
+    # mismatch correction (:388-432)
+    my ( @st, @colph );
+    my $qpos = 0;
+    my $c    = 0;
+    my $qlen = CORE::length($seq);
+    for my $o (@ops) {
+        my ( $op, $ln ) = @$o;
+        if ( $op eq 'M' ) {
+            for my $j ( 0 .. $ln - 1 ) {
+                $st[ $c + $j ]    = substr( $seq, $qpos + $j, 1 );
+                $colph[ $c + $j ] = $ph->[ $qpos + $j ];
+            }
+            $qpos += $ln;
+            $c += $ln;
+        }
+        elsif ( $op eq 'D' ) {
+            my $qb = $qpos > 1 ? $ph->[ $qpos - 1 ] : $ph->[$qpos];
+            my $qa = $qpos < $qlen ? $ph->[$qpos] : $ph->[ $qpos - 1 ];
+            my $dq = min( $qb, $qa );
+            for my $j ( 0 .. $ln - 1 ) {
+                $st[ $c + $j ]    = '-';
+                $colph[ $c + $j ] = $dq;
+            }
+            $c += $ln;
+        }
+        elsif ( $op eq 'I' ) {
+            my $ins  = substr( $seq, $qpos, $ln );
+            my $insq = min( @{$ph}[ $qpos .. $qpos + $ln - 1 ] );
+            my $tgt  = $c - 1;
+            if ( $tgt < 0 ) { $qpos += $ln; next; }
+            if ( $st[$tgt] eq '-' ) {
+                # 1D1I: gap + insertion is really a mismatch (:413-419)
+                $st[$tgt]    = $ins;
+                $colph[$tgt] = $insq;
+            }
+            else {
+                $st[$tgt] .= $ins;
+                $colph[$tgt] = min( $colph[$tgt], $insq );
+            }
+            $qpos += $ln;
+        }
+        else { die "unexpected CIGAR op $op in alignment body"; }
+    }
+    return [ $rpos, \@st, \@colph ];
+}
+
+# -- consensus (Sam/Seq.pm:1568-1654) -------------------------------------
+sub consensus {
+    my ( $self, %opt ) = @_;
+    my $qw  = $opt{qual_weighted} ? 1 : 0;
+    my $urq = $opt{use_ref_qual}  ? 1 : 0;
+
+    my @mat;
+    for my $iid ( keys %{ $self->{alns} } ) {
+        my $ex = $self->_expand_aln( $self->{alns}{$iid} ) or next;
+        my ( $rpos, $st, $colph ) = @$ex;
+        for my $c ( 0 .. $#$st ) {
+            my $col = $rpos + $c;
+            next if $col < 0 or $col >= $self->{len};
+            my $w = $qw ? phred2freq( $colph->[$c] ) : 1;
+            $mat[$col]{ $st->[$c] } += $w;
+        }
+    }
+    my $ref_seq = uc $self->{ref}->seq;
+    if ($urq) {
+        # the long read's own bases vote with phred->freq weight
+        # (Sam/Seq.pm:255-266)
+        my @rp = $self->{ref}->phreds;
+        for my $i ( 0 .. $self->{len} - 1 ) {
+            $mat[$i]{ substr( $ref_seq, $i, 1 ) } +=
+                phred2freq( $rp[$i] // 0 );
+        }
+    }
+
+    my $max_ins = $Attr{MaxInsLength};
+    my ( $seq, $qual ) = ( '', '' );
+    my $po = $Attr{PhredOffset};
+    for my $i ( 0 .. $self->{len} - 1 ) {
+        my $col = $mat[$i];
+        my ( $best, $bw );
+        if ( $col and %$col ) {
+            for my $stt ( keys %$col ) {
+                next if $max_ins and CORE::length($stt) > $max_ins;
+                if ( !defined $bw or $col->{$stt} > $bw ) {
+                    ( $best, $bw ) = ( $stt, $col->{$stt} );
+                }
+            }
+        }
+        if ( !defined $best ) {
+            # untouched column: emit the uncorrected ref base at phred 0
+            $seq  .= substr( $ref_seq, $i, 1 );
+            $qual .= chr( 0 + $po );
+            next;
+        }
+        next if $best eq '-';
+        my $p = freq2phred($bw);
+        $seq  .= $best;
+        $qual .= chr( $p + $po ) x CORE::length($best);
+    }
+    return Fastq::Seq->new(
+        id           => $self->{id},
+        seq          => $seq,
+        qual         => $qual,
+        phred_offset => $po,
+    );
+}
+
+1;

@@ -5,6 +5,11 @@
 # push-down (bam2cns:227-237) and consensus call (bam2cns:434-438).
 use strict;
 use warnings;
+use FindBin;
+# vendored consensus-subset fallback (tests/lib/README.md); the real
+# reference library is pushed in FRONT of it below, so it wins when the
+# /root/reference checkout exists
+use lib "$FindBin::RealBin/lib";
 use lib "/root/reference/lib";
 use Sam::Alignment;
 use Sam::Seq;

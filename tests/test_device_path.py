@@ -727,3 +727,105 @@ class TestScalarWalkKernels:
                                          interpret=True)
             np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
             assert abs(float(f1) - float(f2)) < 1e-6
+
+
+class TestBswV2Equivalence:
+    """bsw_expand_v2 (in-kernel DMA of query rows + map windows, scalar-
+    prefetch metadata) must be bitwise-equal to the v1 oracle: bsw_expand
+    fed the XLA-gathered slabs, with the scanned path's post-kernel MCR
+    gating applied. Covers both strands, N-padded and zero-length queries,
+    band-edge / fully out-of-range window starts, and ignore masks."""
+
+    def _scenario(self, seed=0, R=128, m=128, S=48, B=4, Lp=1024,
+                  with_ignore=True):
+        rng = np.random.default_rng(seed)
+        P = AlignParams()
+        W = bsw.band_lanes(P)
+        n = m + W
+        qlen_set = rng.integers(60, m + 1, S).astype(np.int32)
+        qlen_set[:2] = 0                       # degenerate (empty) reads
+        qf = np.full((S, m), 4, np.int8)
+        for i in range(S):
+            ln = int(qlen_set[i])
+            qf[i, :ln] = rng.integers(0, 4, ln)
+            if ln:                             # real in-read Ns
+                qf[i, rng.integers(0, ln, 3)] = 4
+        rc = np.asarray(device_revcomp(jnp.asarray(qf),
+                                       jnp.asarray(qlen_set)))
+        map2 = rng.integers(0, 5, (B, Lp)).astype(np.int8)
+        ign2 = ((rng.random((B, Lp)) < 0.15) if with_ignore else None)
+        sread = rng.integers(0, S, R).astype(np.int32)
+        sread[:3] = 0                          # hit the empty reads too
+        strand = rng.integers(0, 2, R).astype(np.int32)
+        lread = np.sort(rng.integers(0, B, R)).astype(np.int32)
+        diag = rng.integers(0, Lp, R).astype(np.int32)
+        k = R // 5                             # band-edge + out-of-range
+        diag[:k // 2] = rng.integers(-2 * n, 8, k // 2)
+        diag[k // 2:k] = rng.integers(Lp - 8, Lp + 2 * n, k - k // 2)
+        return (P, W, n, qf, rc, qlen_set, map2, ign2, sread, strand,
+                lread, diag)
+
+    def _v1_oracle(self, P, W, n, qf, rc, qlen_set, map2, ign2,
+                   sread, strand, lread, diag):
+        """The retired _gather_and_align data path + scanned gating."""
+        B, Lp = map2.shape
+        q = np.where(strand[:, None] == 0, qf[sread], rc[sread])
+        qlen = qlen_set[sread]
+        win_start = (diag - W // 2) & ~15
+        idx = win_start[:, None] + np.arange(n, dtype=np.int64)
+        inb = (idx >= 0) & (idx < Lp)
+        flat = lread[:, None] * Lp + np.clip(idx, 0, Lp - 1)
+        win = np.where(inb, map2.reshape(-1)[flat], 4).astype(np.int8)
+        res = bsw.bsw_expand(jnp.asarray(q), jnp.asarray(win),
+                             jnp.asarray(qlen), P, interpret=True)
+        state = np.asarray(res.state)
+        ins_len = np.asarray(res.ins_len)
+        if ign2 is not None:
+            ign = np.where(inb, ign2.reshape(-1)[flat], False)
+            state = np.where(ign, -1, state)
+            ins_len = np.where(ign, 0, ins_len)
+        return res, state, ins_len, win_start, q, qlen
+
+    def _v2_run(self, P, W, n, qf, rc, qlen_set, map2, ign2,
+                sread, strand, lread, diag):
+        Lp = map2.shape[1]
+        map_pad = bsw.build_map_pad(
+            jnp.asarray(map2),
+            None if ign2 is None else jnp.asarray(ign2), n)
+        win_start, w0p = bsw.window_starts(jnp.asarray(diag), W, Lp, n)
+        qlen = qlen_set[sread]
+        return bsw.bsw_expand_v2(
+            jnp.asarray(qf), jnp.asarray(rc), map_pad, jnp.asarray(qlen),
+            jnp.asarray(sread), jnp.asarray(strand), jnp.asarray(lread),
+            w0p, P, interpret=True)
+
+    @pytest.mark.parametrize("seed,with_ignore",
+                             [(0, True), (1, False), (2, True)])
+    def test_bitwise_vs_v1_oracle(self, seed, with_ignore):
+        sc = self._scenario(seed=seed, with_ignore=with_ignore)
+        res1, state1, inslen1, win_start, _, _ = self._v1_oracle(*sc)
+        res2 = self._v2_run(*sc)
+        np.testing.assert_array_equal(state1, np.asarray(res2.state))
+        np.testing.assert_array_equal(inslen1, np.asarray(res2.ins_len))
+        for f in ("qrow", "ins_b0", "ins_b1", "score", "q_start", "q_end",
+                  "r_start", "r_end", "valid"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(res1, f)), np.asarray(getattr(res2, f)),
+                err_msg=f)
+
+    def test_packed_vote_words_roundtrip(self):
+        """encode_votes_packed_bases on the v2 kernel's packed inserted-base
+        words must produce the same vote words as the gather-based
+        encode_votes fed the oriented query slabs."""
+        from proovread_tpu.ops.votes import (encode_votes,
+                                             encode_votes_packed_bases)
+        sc = self._scenario(seed=5, with_ignore=False)
+        res1, state1, inslen1, _, q, _ = self._v1_oracle(*sc)
+        res2 = self._v2_run(*sc)
+        words_g = encode_votes(res1.state, res1.qrow, res1.ins_len,
+                               jnp.asarray(q), res1.q_start, res1.q_end)
+        words_p = encode_votes_packed_bases(
+            res2.state, res2.qrow, res2.ins_len, res2.ins_b0, res2.ins_b1,
+            res2.q_start, res2.q_end)
+        np.testing.assert_array_equal(np.asarray(words_g),
+                                      np.asarray(words_p))

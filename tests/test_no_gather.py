@@ -1,0 +1,185 @@
+"""No-gather guard: the fused per-chunk path must stay gather-free.
+
+PERF.md's round-4 profile showed ~80% of device time in XLA gathers /
+scatters / relayouts executing on the TPU scalar core at ~10 ns/element,
+against ~10% in the bsw alignment kernel itself. bsw v2 (in-kernel DMA of
+query rows + map windows, packed inserted-base emission) removed every
+XLA gather from the per-chunk fused path; this lint pins that property so
+it cannot silently regress.
+
+Rule: in the jaxpr of the fused pass (and of the fused iteration
+program), every ``scan`` whose body contains a ``pallas_call`` is a chunk
+loop — its body must contain ZERO ``gather`` equations (recursively,
+through cond branches and nested jits, but NOT inside pallas kernels,
+which are Mosaic-compiled and never lower to XLA scalar-core gathers).
+Scans without kernels (the seeder's probe-slab scan, searchsorted's
+binary-search scan inside the per-pass admission) legitimately gather and
+are out of scope: they run once per pass, not once per chunk.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.extend import core as jax_core
+
+from proovread_tpu.align import bsw
+from proovread_tpu.align.params import AlignParams
+from proovread_tpu.consensus.params import ConsensusParams
+
+
+def _sub_jaxprs(eqn):
+    """Immediate child jaxprs of one equation (scan/cond/while/pjit/...)."""
+    for v in eqn.params.values():
+        if isinstance(v, jax_core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jax_core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                if isinstance(x, jax_core.ClosedJaxpr):
+                    yield x.jaxpr
+                elif isinstance(x, jax_core.Jaxpr):
+                    yield x
+
+
+def _walk(jaxpr, *, into_pallas=False):
+    """All equations under ``jaxpr``, depth-first."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        if eqn.primitive.name == "pallas_call" and not into_pallas:
+            continue
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk(sub, into_pallas=into_pallas)
+
+
+def _contains_pallas(jaxpr) -> bool:
+    return any(e.primitive.name == "pallas_call" for e in _walk(jaxpr))
+
+
+def _chunk_scan_bodies(closed):
+    """Bodies of every scan that contains a pallas_call (= a chunk loop)."""
+    out = []
+
+    def visit(jaxpr):
+        for eqn in jaxpr.eqns:
+            subs = list(_sub_jaxprs(eqn))
+            if eqn.primitive.name == "scan":
+                out.extend(s for s in subs if _contains_pallas(s))
+            if eqn.primitive.name != "pallas_call":
+                for s in subs:
+                    visit(s)
+
+    visit(closed.jaxpr)
+    return out
+
+
+def _assert_gather_free(bodies, what):
+    assert bodies, f"{what}: no kernel-bearing chunk scans found — the " \
+        "fused path changed shape; update this lint, don't delete it"
+    for body in bodies:
+        gathers = [e for e in _walk(body)
+                   if e.primitive.name == "gather"]
+        assert not gathers, (
+            f"{what}: {len(gathers)} XLA gather op(s) reappeared inside a "
+            f"chunk scan (first: {gathers[0]}). Every per-chunk gather "
+            "runs at ~10 ns/element on the TPU scalar core — route the "
+            "access through the bsw v2 kernel's DMA path instead "
+            "(PERF.md attack plan #2).")
+
+
+def _small_args(B=2, Lp=256, S=8, m=128, CH=128, n_chunks=2):
+    ap = AlignParams()
+    W = bsw.band_lanes(ap)
+    rng = np.random.default_rng(0)
+    map2 = jnp.asarray(rng.integers(0, 5, (B, Lp)).astype(np.int8))
+    ign2 = jnp.asarray(rng.random((B, Lp)) < 0.1)
+    codes = map2
+    qual = jnp.asarray(rng.integers(0, 41, (B, Lp)).astype(np.uint8))
+    lengths = jnp.full(B, Lp, jnp.int32)
+    qf = jnp.asarray(rng.integers(0, 5, (S, m)).astype(np.int8))
+    qlen = jnp.full(S, m, jnp.int32)
+    R = CH * n_chunks
+    sread = jnp.asarray(rng.integers(0, S, R).astype(np.int32))
+    strand = jnp.asarray(rng.integers(0, 2, R).astype(np.int8))
+    lread = jnp.asarray(np.sort(rng.integers(0, B, R)).astype(np.int32))
+    diag = jnp.asarray(rng.integers(0, Lp, R).astype(np.int32))
+    return (ap, W, m, CH, n_chunks, map2, ign2, codes, qual, lengths,
+            qf, qlen, sread, strand, lread, diag)
+
+
+def test_fused_pass_chunk_loop_gather_free():
+    from proovread_tpu.pipeline.dcorrect import _fused_pass_body
+
+    (ap, W, m, CH, n_chunks, map2, ign2, codes, qual, lengths,
+     qf, qlen, sread, strand, lread, diag) = _small_args()
+    cns = ConsensusParams(qual_weighted=False, use_ref_qual=True)
+
+    def f(map2, ign2, codes, qual, lengths, qf, qlen,
+          sread, strand, lread, diag, n_cand):
+        return _fused_pass_body(
+            map2, ign2, codes, qual, lengths, qf, qf, qual[:, :m], qlen,
+            sread, strand, lread, diag, n_cand,
+            m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
+            interpret=True, collect=False)
+
+    closed = jax.make_jaxpr(f)(
+        map2, ign2, codes, qual, lengths, qf, qlen,
+        sread, strand, lread, diag, jnp.int32(CH))
+    _assert_gather_free(_chunk_scan_bodies(closed), "fused_pass")
+
+
+def test_fused_iterations_chunk_loop_gather_free():
+    from proovread_tpu.pipeline.dcorrect import fused_iterations
+
+    (ap, W, m, CH, n_chunks, map2, ign2, codes, qual, lengths,
+     qf, qlen, sread, strand, lread, diag) = _small_args()
+    cns = ConsensusParams(qual_weighted=False, use_ref_qual=True)
+    B, Lp = codes.shape
+    n_rest = 2
+    sels = jnp.zeros((n_rest, qf.shape[0]), jnp.int32)
+    pvs = jnp.zeros((n_rest, 6), jnp.float32)
+
+    def f(codes, qual, lengths, mask_cols, sr_codes, sr_qual, sr_lengths,
+          sels, pvs):
+        return fused_iterations(
+            codes, qual, lengths, mask_cols, jnp.float32(0.0),
+            sr_codes, sr_codes, sr_qual, sr_lengths, sels, pvs,
+            m=m, W=W, CH=CH, n_chunks=n_chunks, ap=ap, cns=cns,
+            interpret=True, n_rest=n_rest, Lp=Lp,
+            seed_stride=8, seed_min_votes=2,
+            shortcut_frac=0.92, min_gain=0.03)
+
+    closed = jax.make_jaxpr(f)(
+        codes, qual, lengths, ign2, qf, qual[:, :m].astype(jnp.uint8),
+        qlen, sels, pvs)
+    _assert_gather_free(_chunk_scan_bodies(closed), "fused_iterations")
+
+
+def test_lint_catches_a_planted_gather():
+    """The guard itself must be falsifiable: a scan body that runs a
+    pallas kernel AND a take_along_axis gather must trip the assertion."""
+    from jax.experimental import pallas as pl
+
+    def noop_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def body(carry, idx):
+        x = jnp.ones((8, 128), jnp.float32)
+        y = pl.pallas_call(
+            noop_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=True)(x)
+        g = jnp.take_along_axis(y, idx, axis=1)      # the planted gather
+        return carry + g.sum(), None
+
+    def f(idxs):
+        out, _ = jax.lax.scan(body, jnp.float32(0), idxs)
+        return out
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((3, 8, 1), jnp.int32))
+    bodies = _chunk_scan_bodies(closed)
+    assert bodies
+    with pytest.raises(AssertionError, match="gather"):
+        _assert_gather_free(bodies, "planted")
