@@ -10,7 +10,7 @@
 #   make perf-report  PERF.md-style phase/kernel tables from that history
 #   make bench        the benchmark itself (one JSON row on stdout)
 
-.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke perf-check perf-report bench
+.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke perf-check perf-report bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -59,6 +59,17 @@ qc-smoke:
 # strictly schema-valid SLO artifact, and no live-array leak
 serve-smoke:
 	JAX_PLATFORMS=cpu python -m proovread_tpu.serve.smoke
+
+# mesh fault-domain tier (docs/RESILIENCE.md "Mesh fault domains"): a
+# 4-way simulated CPU mesh runs the shard-exact workload with one
+# injected fault per mesh kind — the headline device_lost@d1.p2 must
+# complete via the shrunken-mesh rung with a --qc-out aggregate
+# byte-identical to the unfaulted single-device run — then a real
+# SIGTERM kills a mesh=4 child mid-run and the journal resumes at
+# mesh=2, byte-identically; LeakCheck at exit
+dmesh-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+		python -m proovread_tpu.parallel.smoke
 
 # perf-regression gate (docs/OBSERVABILITY.md): newest usable BENCH row vs
 # a rolling baseline — headline bases/sec, wall, and per-phase deltas.

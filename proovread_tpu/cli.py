@@ -92,6 +92,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--no-ladder", action="store_true",
                     help="fail fast on device faults instead of retrying "
                          "buckets down the degradation ladder")
+    ap.add_argument("--mesh-shards", type=int, metavar="N",
+                    help="shard every bucket's iteration passes over N "
+                         "devices (data-parallel dp mesh). A chip-level "
+                         "fault drops the failed shard, rebalances its "
+                         "reads onto the survivors and recompiles — then "
+                         "single-device, then the host rungs "
+                         "(docs/RESILIENCE.md 'Mesh fault domains')")
+    ap.add_argument("--mesh-pass-timeout", type=float, metavar="SECONDS",
+                    help="soft wall-clock budget per sharded iteration "
+                         "pass; a breach counts as a 'straggler' mesh "
+                         "fault")
     ap.add_argument("--trace", metavar="FILE",
                     help="write the span tree as Chrome trace-event JSONL "
                          "(open in ui.perfetto.dev) and log an end-of-run "
@@ -255,6 +266,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg.data["bucket-timeout"] = args.bucket_timeout
     if args.no_ladder:
         cfg.data["resilience-ladder"] = 0
+    if args.mesh_shards is not None:
+        cfg.data["mesh-shards"] = args.mesh_shards
+    if args.mesh_pass_timeout is not None:
+        cfg.data["mesh-pass-timeout"] = args.mesh_pass_timeout
     name = os.path.basename(outdir.rstrip("/")) or "proovread"
 
     # observability (docs/OBSERVABILITY.md): flags override config keys so

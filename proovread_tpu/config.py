@@ -166,6 +166,19 @@ DEFAULTS: Dict[str, Any] = {
     # fault-injection spec (testing/faults.py grammar, e.g.
     # "compile@b0.p2;oom@b1"); null reads the PROOVREAD_FAULT env var
     "fault-spec": None,
+    # -- multi-chip mesh (parallel/dmesh.py; docs/RESILIENCE.md "Mesh
+    # fault domains") -----------------------------------------------------
+    # shard iteration passes over this many devices (dp axis); null/0/1 =
+    # single-device. Deliberately NOT part of the checkpoint fingerprint:
+    # a journal written under one mesh shape resumes under another
+    "mesh-shards": None,
+    # static per-shard candidate budget of the sharded step, in units of
+    # device-chunk; a pass that would overflow it retreats to the
+    # single-device rung ('cap_overflow'), never truncates silently
+    "mesh-chunks-per-shard": 2,
+    # soft wall-clock budget per sharded iteration pass in seconds; a
+    # breach is a 'straggler' mesh fault (null = no budget)
+    "mesh-pass-timeout": None,
     # -- observability (proovread_tpu/obs; docs/OBSERVABILITY.md) ---------
     # span-tree trace as Chrome trace-event JSONL (Perfetto-loadable);
     # the CLI --trace flag overrides. null = tracing off (default)

@@ -71,6 +71,59 @@ QC_TRIM_FIELDS = {"pieces": (int,), "chimera_bases_lost": (int,),
                   "bases_out": (int,)}
 
 
+# -- mesh fault-domain metrics schema (pipeline/driver.py writer) ----------
+# Declared HERE, independently of the driver's _declare_metrics, with the
+# same discipline as the QC schema: validate_mesh_metrics is STRICT — a
+# mesh_* metric the driver dumps that is not declared below fails, and a
+# declared one that is absent fails — and a lint-guard test
+# (tests/test_dmesh_faults.py) drives _declare_metrics against this
+# declaration so the two can never silently drift.
+MESH_SCHEMA_VERSION = 1
+MESH_COUNTERS = ("mesh_passes", "mesh_faults", "mesh_demotions")
+MESH_GAUGES = ("mesh_shards_configured", "mesh_shards_active",
+               "mesh_rebalanced_reads")
+# labels every non-empty series of these counters must carry (the
+# shard-attributed accounting: which chip, which fault, where the bucket
+# landed)
+MESH_COUNTER_LABELS = {"mesh_faults": ("kind", "shard"),
+                       "mesh_demotions": ("to_rung",)}
+
+
+def validate_mesh_metrics(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    """Strictly validate the ``mesh_*`` slice of a metrics dump (the
+    ``PipelineResult.metrics`` / ``--metrics-out`` object). Returns
+    summary stats ({'mesh_passes': N, 'mesh_faults': N})."""
+    if not isinstance(metrics, dict):
+        _fail("mesh metrics: not a metrics dict")
+    counters = metrics.get("counters", {})
+    gauges = metrics.get("gauges", {})
+    for name in MESH_COUNTERS:
+        if name not in counters:
+            _fail(f"mesh metrics: declared counter {name!r} absent")
+    for name in MESH_GAUGES:
+        if name not in gauges:
+            _fail(f"mesh metrics: declared gauge {name!r} absent")
+    for section, declared in (("counters", MESH_COUNTERS),
+                              ("gauges", MESH_GAUGES),
+                              ("histograms", ())):
+        for name in metrics.get(section, {}):
+            if name.startswith("mesh_") and name not in declared:
+                _fail(f"mesh metrics: undeclared {section[:-1]} {name!r} "
+                      "(extend obs/validate.py MESH_* first)")
+    for name, want in MESH_COUNTER_LABELS.items():
+        for s in counters[name].get("series", ()):
+            labels = s.get("labels", {})
+            for lb in want:
+                if lb not in labels:
+                    _fail(f"mesh metrics: {name} series lacks the "
+                          f"{lb!r} label (got {sorted(labels)})")
+    n_passes = sum(s.get("value", 0)
+                   for s in counters["mesh_passes"].get("series", ()))
+    n_faults = sum(s.get("value", 0)
+                   for s in counters["mesh_faults"].get("series", ()))
+    return {"mesh_passes": int(n_passes), "mesh_faults": int(n_faults)}
+
+
 # -- serving SLO artifact schema (serve/server.py writer) ------------------
 # Same declaration discipline as the QC schema: declared here,
 # independently of the writer, and validated STRICTLY (undeclared fields

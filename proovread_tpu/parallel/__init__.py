@@ -10,8 +10,17 @@ limited to chunk merge + global masked-% stats (``bin/proovread:1640-1718``).
 """
 
 from proovread_tpu.parallel.dmesh import (
+    build_sharded_step,
+    compile_step_with_plan,
     make_dp_mesh,
     sharded_iteration_step,
 )
+from proovread_tpu.parallel.plan import (
+    balance_placement,
+    moved_reads,
+    shard_of_rows,
+)
 
-__all__ = ["make_dp_mesh", "sharded_iteration_step"]
+__all__ = ["balance_placement", "build_sharded_step",
+           "compile_step_with_plan", "make_dp_mesh", "moved_reads",
+           "shard_of_rows", "sharded_iteration_step"]

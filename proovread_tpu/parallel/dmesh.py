@@ -7,70 +7,156 @@ the long-read batch across the mesh's ``dp`` axis with the short-read batch
 replicated: every device runs the SAME fused pass (seeding -> banded SW ->
 admission -> pileup -> consensus -> assembly -> HCR mask) on its local read
 shard — the identical code path the single-chip pipeline runs
-(``pipeline/dcorrect.py:_fused_pass_body``) — and only the two iteration
-KPIs (masked bases, admitted count) cross the interconnect, as ``psum``
-scalars. There is no other communication: the problem is embarrassingly
-parallel over reads, so ICI carries O(1) bytes per pass.
+(``pipeline/dcorrect.py:_fused_pass_body``) — and only the iteration KPIs
+(masked bases, admitted/eligible/candidate counts) cross the interconnect,
+as ``psum`` scalars. There is no other communication: the problem is
+embarrassingly parallel over reads, so ICI carries O(1) bytes per pass.
+
+Three layers live here:
+
+* :func:`compile_step_with_plan` — the ONE compile chokepoint (the
+  Titanax pattern from SNIPPETS.md): given a step body and an optional
+  mesh it picks plain ``jit`` (no mesh) or ``shard_map``-under-``jit``
+  (any mesh shape), always through ``parallel/compat.py`` so jax's
+  shard_map relocations stay one import away.
+* :func:`build_sharded_step` — the cached builder of the extended
+  iteration step for a given ``(mesh, align params, consensus params)``;
+  a shrunken mesh after a shard loss is just a new cache key
+  ("recompile for the new shape" in docs/RESILIENCE.md).
+* :func:`sharded_iteration_step` — the original dryrun-era contract
+  (static mask params, device-side masked fraction), kept as a thin
+  wrapper for the dmesh tests and ``__graft_entry__.dryrun_multichip``.
+
+Read placement across shards is NOT decided here: the driver permutes the
+bucket with ``parallel/plan.py:balance_placement`` (candidate-balanced,
+not a naive B/n split) before the arrays reach the step, and un-permutes
+once after the iteration loop. Per shard, the step body is
+``_fused_pass_body`` unmodified — the gather-free property of the chunk
+scan (tests/test_no_gather.py) therefore holds per shard by construction.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
 
+from proovread_tpu.parallel import compat
+from proovread_tpu.parallel.compat import Mesh, PartitionSpec as P
 from proovread_tpu.align import bsw, dseed
 from proovread_tpu.align.params import AlignParams
 from proovread_tpu.consensus.params import ConsensusParams
 from proovread_tpu.ops.encode import N
-from proovread_tpu.pipeline.dcorrect import (_fused_pass_body, _pad_candidates,
+from proovread_tpu.pipeline.dcorrect import (_fused_pass_body,
+                                             _pad_candidates,
                                              device_assemble,
-                                             device_hcr_mask)
+                                             device_hcr_mask_dyn,
+                                             mask_params_vec,
+                                             qc_pass_row_stats,
+                                             qc_row_mask_counts)
 from proovread_tpu.pipeline.masking import MaskParams
 
 
-def make_dp_mesh(n_devices: Optional[int] = None) -> Mesh:
-    devs = jax.devices()
-    n = n_devices or len(devs)
-    return Mesh(np.array(devs[:n]), ("dp",))
+def make_dp_mesh(n_devices: Optional[int] = None,
+                 devices: Optional[list] = None) -> Mesh:
+    """1-D ``dp`` mesh over ``devices`` (default: the first ``n_devices``
+    of ``jax.devices()``). Passing an explicit device list is how the
+    shrunken-mesh rung excludes a lost shard's chip."""
+    if devices is None:
+        devs = jax.devices()
+        devices = devs[:(n_devices or len(devs))]
+    return Mesh(np.array(devices), ("dp",))
 
 
-def sharded_iteration_step(
+def compile_step_with_plan(body, mesh: Optional[Mesh] = None,
+                           in_specs=None, out_specs=None,
+                           check_vma: bool = False):
+    """Central compile chokepoint for iteration steps (SNIPPETS.md's
+    Titanax ``compile_step_with_plan``): no mesh -> plain ``jit`` of the
+    body; any mesh -> ``shard_map`` (via the version shim) under ``jit``.
+    Every mesh shape — full, shrunken-after-a-loss, single-device — goes
+    through here, so there is exactly one place that knows how a step is
+    partitioned."""
+    if mesh is None:
+        return jax.jit(body)
+    mapped = compat.shard_map(body, mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma)
+    return jax.jit(mapped)
+
+
+# compiled steps keyed by (device ids, params, statics) — a shrunken mesh
+# or a different align-params pass reuses its entry across buckets; jit
+# handles shape changes (Lp, query slab rows) by retracing internally
+_STEP_CACHE: dict = {}
+
+
+def clear_step_cache() -> None:
+    _STEP_CACHE.clear()
+
+
+def build_sharded_step(
     mesh: Mesh,
     ap: AlignParams,
     cns: ConsensusParams,
-    mask_params: MaskParams,
-    Lp: int,
-    m: int,
     chunks_per_shard: int = 2,
     chunk: int = 8192,
     seed_stride: int = 8,
     seed_min_votes: int = 2,
     interpret: Optional[bool] = None,
+    collect_qc: bool = False,
 ):
-    """Build the jitted multi-chip iteration step.
+    """Build (or fetch cached) the extended sharded iteration step.
 
-    Returns ``step(codes, qual, lengths, mask_cols, qc, rcq, qq, qlen) ->
-    (new_codes, new_qual, new_lengths, new_mask, masked_frac, n_admitted)``
-    with the read tensors sharded over ``dp`` and queries replicated.
+    ``step(codes, qual, lengths, mask_cols, row_valid, qc, rcq, qq,
+    qlen, pvec)`` with read tensors + the per-row ``row_valid`` flag
+    sharded over ``dp``, queries + the 6-vector mask params
+    (``mask_params_vec``) replicated, returning::
+
+        (new_codes, new_qual, new_len, new_mask,        # sharded [B, *]
+         masked_i, total_i,        # psum i32: HCR-masked / total bases
+         n_admitted, n_eligible,   # psum i32: admission KPIs
+         n_candidates, n_dropped_cap)  # psum i32: seeded / cap-truncated
+        [+ (mask_rows, edits, uplift)  # sharded [B] QC rows, collect_qc]
+
+    ``row_valid`` masks the masked/total psums: a mesh whose shard count
+    does not divide the single-device row count pads EXTRA sentinel rows,
+    and those must not enter the fraction's sums — the shortcut decision
+    has to divide exactly the sums the single-device run would (the base
+    pad rows up to ``_batch_rows`` ARE included there, so they stay
+    valid; only the mesh-rounding surplus is flagged out). The fraction
+    itself is NOT divided on device: the driver derives it host-side from
+    the two integer sums exactly like the single-device path does, so the
+    decision is rung- and mesh-shape-invariant. Shapes (Lp, B, query slab
+    rows) are taken from the traced arrays — only the params here are
+    static, and each distinct value set compiles once per mesh.
 
     ``chunks_per_shard`` statically caps per-shard candidates at
     ``chunks_per_shard * chunk`` (a shard_map body cannot size its chunk
     loop from a traced candidate count the way the single-chip driver
-    does); overflow candidates are dropped deterministically from the
-    compacted tail.
+    does); overflow is counted in ``n_dropped_cap``. The driver treats a
+    nonzero count as a mesh fault and retreats to the single-device rung
+    (dynamic chunk count, never truncates) instead of accepting silently
+    truncated — and therefore mesh-shape-DEpendent — output.
     """
+    itp = bsw.default_interpret() if interpret is None else interpret
+    key = (tuple(int(d.id) for d in mesh.devices.flat), ap, cns,
+           chunks_per_shard, chunk, seed_stride, seed_min_votes, itp,
+           collect_qc)
+    step = _STEP_CACHE.get(key)
+    if step is not None:
+        return step
+
     W = bsw.band_lanes(ap)
     CH = chunk
     n_chunks = chunks_per_shard
     R_need = n_chunks * CH
-    itp = bsw.default_interpret() if interpret is None else interpret
 
-    def local_step(codes, qual, lengths, mask_cols, qc, rcq, qq, qlen):
+    def local_step(codes, qual, lengths, mask_cols, row_valid,
+                   qc, rcq, qq, qlen, pvec):
+        Lp = codes.shape[1]
+        m = qc.shape[1]
         map_codes = jnp.where(mask_cols, jnp.int8(N), codes)
         index = dseed.device_index(map_codes, lengths, ap.min_seed_len)
         cand = dseed.probe_candidates(
@@ -82,7 +168,7 @@ def sharded_iteration_step(
             sread, strand, lread, diag, R_need)
         n_cand = jnp.minimum(n_valid, R_need).astype(jnp.int32)
 
-        call, n_admitted, _n_eligible, _, _, _ = _fused_pass_body(
+        call, n_admitted, n_eligible, _, _, _ = _fused_pass_body(
             map_codes, mask_cols,
             codes, qual, lengths, qc, rcq, qq, qlen,
             sread, strand, lread, diag, n_cand,
@@ -91,20 +177,65 @@ def sharded_iteration_step(
 
         new_codes, new_qual, new_len = device_assemble(
             call, lengths, Lp, interpret=itp)
-        new_mask, _ = device_hcr_mask(new_qual, new_len, mask_params)
+        new_mask, _ = device_hcr_mask_dyn(new_qual, new_len, pvec,
+                                          interpret=itp)
 
-        masked = jax.lax.psum(jnp.sum(new_mask), "dp")
-        total = jax.lax.psum(jnp.maximum(jnp.sum(new_len), 1), "dp")
-        n_adm = jax.lax.psum(n_admitted, "dp")
-        frac = masked / total
-        return new_codes, new_qual, new_len, new_mask, frac, n_adm
+        psum = lambda v: jax.lax.psum(v.astype(jnp.int32), "dp")  # noqa: E731
+        outs = (new_codes, new_qual, new_len, new_mask,
+                psum(jnp.sum(new_mask & row_valid[:, None])),
+                psum(jnp.sum(jnp.where(row_valid, new_len, 0))),
+                psum(n_admitted), psum(n_eligible),
+                psum(n_valid), psum(jnp.maximum(n_valid - R_need, 0)))
+        if collect_qc:
+            ed, up = qc_pass_row_stats(call, codes, qual, lengths)
+            outs = outs + (qc_row_mask_counts(new_mask), ed, up)
+        return outs
 
-    shard = P("dp")
-    repl = P()
-    mapped = jax.shard_map(
-        local_step, mesh=mesh,
-        in_specs=(shard, shard, shard, shard, repl, repl, repl, repl),
-        out_specs=(shard, shard, shard, shard, repl, repl),
-        check_vma=False,
-    )
-    return jax.jit(mapped)
+    shard, repl = P("dp"), P()
+    n_repl_out = 6
+    out_specs = (shard,) * 4 + (repl,) * n_repl_out
+    if collect_qc:
+        out_specs = out_specs + (shard,) * 3
+    step = compile_step_with_plan(
+        local_step, mesh,
+        in_specs=(shard,) * 5 + (repl,) * 5,
+        out_specs=out_specs,
+        check_vma=False)
+    _STEP_CACHE[key] = step
+    return step
+
+
+def sharded_iteration_step(
+    mesh: Mesh,
+    ap: AlignParams,
+    cns: ConsensusParams,
+    mask_params: MaskParams,
+    Lp: int,                      # kept for API compat; shapes now rule
+    m: int,                       # (traced arrays carry Lp and m)
+    chunks_per_shard: int = 2,
+    chunk: int = 8192,
+    seed_stride: int = 8,
+    seed_min_votes: int = 2,
+    interpret: Optional[bool] = None,
+):
+    """Original dryrun-era contract over :func:`build_sharded_step`:
+    ``step(codes, qual, lengths, mask_cols, qc, rcq, qq, qlen) ->
+    (new_codes, new_qual, new_lengths, new_mask, masked_frac,
+    n_admitted)`` with static mask params and the fraction derived from
+    the psum'd integer sums."""
+    del Lp, m
+    step = build_sharded_step(
+        mesh, ap, cns, chunks_per_shard=chunks_per_shard, chunk=chunk,
+        seed_stride=seed_stride, seed_min_votes=seed_min_votes,
+        interpret=interpret)
+    pvec = mask_params_vec(mask_params)
+
+    def run(codes, qual, lengths, mask_cols, qc, rcq, qq, qlen):
+        out = step(codes, qual, lengths, mask_cols,
+                   jnp.ones(codes.shape[0], bool),
+                   qc, rcq, qq, qlen, pvec)
+        nc, nq, nl, nm, masked_i, total_i, n_adm = out[:7]
+        frac = masked_i / jnp.maximum(total_i, 1)
+        return nc, nq, nl, nm, frac, n_adm
+
+    return run

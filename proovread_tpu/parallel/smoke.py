@@ -1,0 +1,251 @@
+"""End-to-end mesh fault-domain smoke (``make dmesh-smoke``).
+
+Runs the full multi-chip robustness envelope on a 4-way SIMULATED CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=4``) with the
+shard-exact workload family (``io/simulate.py:
+simulate_independent_segments`` — every long read owns its genome segment,
+so sharded execution is exact, and "byte-identical" is a meaningful
+assert):
+
+1. **baseline** — single-device run, QC on: the reference ``--qc-out``
+   aggregate every later phase must reproduce byte-for-byte;
+2. **headline** — ``device_lost@d1.p2``: shard 1's chip dies at iteration
+   2 of the 4-way mesh; the run must complete via the shrunken-mesh rung
+   (``mesh-dp3``), with the demotion attributed to shard 1 in the
+   ``mesh_faults`` counter and the QC aggregate identical to baseline;
+3. **one fault per remaining mesh kind** — ``straggler`` (shrinks, like a
+   chip loss), ``shard_oom`` and ``collective_timeout`` (retreat straight
+   to the single-device rungs); each completes with an identical
+   aggregate and the right shard attribution;
+4. **SIGTERM + mesh-shape-invariant resume** — a child process runs the
+   mesh=4 pipeline with the checkpoint journal and kills itself with a
+   real SIGTERM right after bucket 0 is journaled; the parent resumes the
+   SAME journal at mesh=2 and must replay/complete to a byte-identical
+   aggregate (journal entries are keyed by read content, never shard
+   slot);
+5. **LeakCheck** — no live-array leak once the runs are done.
+
+Runs on CPU in a few minutes (interpret-mode Pallas device engine, tiny
+disjoint-segment genome). ``--child <ckpt-dir>`` is the phase-4 child
+entry — not for direct use.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+SEED = 11
+N_LONG, READ_LEN, SR_PER = 12, 300, 6
+HEADLINE_FAULT = "device_lost@d1.p2"
+
+
+def _env_setup(n_devices: int = 4) -> None:
+    """Must run before jax initializes (the Makefile target and the
+    child both enter through here)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), ".jax_cache_cpu")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def _log(msg: str) -> None:
+    print(f"[dmesh-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def _workload():
+    from proovread_tpu.io.simulate import simulate_independent_segments
+    return simulate_independent_segments(
+        seed=SEED, n_long=N_LONG, read_len=READ_LEN, sr_per=SR_PER)
+
+
+def _pcfg(**kw):
+    from proovread_tpu.pipeline.driver import PipelineConfig
+    from proovread_tpu.pipeline.trim import TrimParams
+    cfg = dict(mode="sr", n_iterations=2, sampling=False,
+               device_chunk=128, batch_reads=8, host_chunk_rows=512,
+               mesh_chunks_per_shard=1,
+               trim=TrimParams(min_length=150))
+    cfg.update(kw)
+    return PipelineConfig(**cfg)
+
+
+def _run(longs, srs, bucket_done=None, **kw):
+    """One pipeline run under a QC scope; returns (qc aggregate JSON
+    bytes, per-read record dict, PipelineResult)."""
+    from proovread_tpu import obs
+    from proovread_tpu.pipeline.driver import Pipeline
+    pipe = Pipeline(_pcfg(**kw))
+    if bucket_done is not None:
+        pipe._bucket_done = bucket_done
+    with obs.qc.scope() as rec:
+        res = pipe.run(longs, srs)
+        agg = json.dumps(rec.aggregate(), sort_keys=True).encode()
+        recs = {r["id"]: r for r in rec.iter_records()}
+    return agg, recs, res
+
+
+def _counter(res, name):
+    return {tuple(sorted(s["labels"].items())): s["value"]
+            for s in res.metrics["counters"][name]["series"]}
+
+
+def _child(ckpt_dir: str) -> int:
+    """Phase-4 child: mesh=4 run with the journal, real SIGTERM to self
+    right after bucket 0 completes (journal.put precedes _bucket_done, so
+    the entry is on disk when the signal lands)."""
+    longs, srs = _workload()
+
+    def die_after_first(gi, results, chim, replayed):
+        if gi == 0:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    _run(longs, srs, bucket_done=die_after_first,
+         mesh_shards=4, checkpoint_dir=ckpt_dir)
+    _log("child: ran to completion — SIGTERM never fired?")
+    return 1
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    _env_setup(4)
+    if argv[:1] == ["--child"]:
+        return _child(argv[1])
+
+    import glob
+    import tempfile
+
+    import jax
+    from proovread_tpu.obs.memory import LeakCheck
+    from proovread_tpu.obs.validate import (ValidationError,
+                                            validate_mesh_metrics)
+
+    if jax.device_count() < 4:
+        # `python -m` imports the package (whose jax-touching import
+        # chain initializes the backend) BEFORE this module's env setup
+        # can run — re-exec once with the device-count flag exported,
+        # exactly what the Makefile target does up front
+        if os.environ.get("_DMESH_SMOKE_REEXEC") != "1":
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=4").strip()
+            env["_DMESH_SMOKE_REEXEC"] = "1"
+            _log("re-exec with a 4-device simulated CPU platform")
+            return subprocess.run(
+                [sys.executable, "-m", "proovread_tpu.parallel.smoke"]
+                + argv, env=env).returncode
+        _log(f"FAILED: need 4 simulated devices, have {jax.device_count()}")
+        return 1
+    leak = LeakCheck()
+    longs, srs = _workload()
+    _log(f"workload: {len(longs)} long reads (disjoint segments), "
+         f"{len(srs)} short reads, 2 length buckets")
+
+    # -- phase 1: single-device baseline ---------------------------------
+    agg0, recs0, res0 = _run(longs, srs)
+    _log(f"baseline: {len(recs0)} QC records, "
+         f"aggregate {len(agg0)} bytes")
+
+    # -- phase 2: headline — chip loss mid-iteration ----------------------
+    agg1, recs1, res1 = _run(longs, srs, mesh_shards=4,
+                             fault_spec=HEADLINE_FAULT)
+    demotes = [r.note for r in res1.reports if r.task.startswith("demote")]
+    if not any("mesh-dp3" in n and "shard 1" in n for n in demotes):
+        _log(f"FAILED: {HEADLINE_FAULT} did not demote to mesh-dp3 "
+             f"(demotions: {demotes})")
+        return 1
+    if agg1 != agg0 or recs1 != recs0:
+        _log("FAILED: shrunken-mesh output differs from baseline")
+        return 1
+    try:
+        stats = validate_mesh_metrics(res1.metrics)
+    except ValidationError as e:
+        _log(f"FAILED: mesh metrics schema: {e}")
+        return 1
+    faults1 = _counter(res1, "mesh_faults")
+    if faults1.get((("kind", "device_lost"), ("shard", "1"))) is None:
+        _log(f"FAILED: device_lost not attributed to shard 1: {faults1}")
+        return 1
+    _log(f"headline OK: {HEADLINE_FAULT} -> mesh-dp3, byte-identical "
+         f"aggregate, {stats}")
+
+    # -- phase 3: one fault per remaining kind ----------------------------
+    for spec, want_rung, shard in (("straggler@d3.p2x1", "mesh-dp3", "3"),
+                                   ("shard_oom@d2.p1x1", "fused", "2"),
+                                   ("collective_timeout@d0.p1x1",
+                                    "fused", "0")):
+        kind = spec.split("@")[0]
+        agg_k, recs_k, res_k = _run(longs, srs, mesh_shards=4,
+                                    fault_spec=spec)
+        demotes = [r.note for r in res_k.reports
+                   if r.task.startswith("demote")]
+        if not any(f"'{want_rung}'" in n for n in demotes):
+            _log(f"FAILED: {spec} did not demote to {want_rung}: "
+                 f"{demotes}")
+            return 1
+        faults_k = _counter(res_k, "mesh_faults")
+        if faults_k.get((("kind", kind), ("shard", shard))) is None:
+            _log(f"FAILED: {kind} not attributed to shard {shard}: "
+                 f"{faults_k}")
+            return 1
+        if agg_k != agg0 or recs_k != recs0:
+            _log(f"FAILED: {spec} output differs from baseline")
+            return 1
+        _log(f"{spec} OK -> {want_rung}, byte-identical aggregate")
+
+    # -- phase 4: SIGTERM mid-run at mesh=4, resume at mesh=2 -------------
+    with tempfile.TemporaryDirectory(prefix="proovread_dmesh_") as tmp:
+        ckpt = os.path.join(tmp, "ckpt")
+        child = subprocess.run(
+            [sys.executable, "-m", "proovread_tpu.parallel.smoke",
+             "--child", ckpt],
+            env=os.environ, cwd=os.getcwd(), timeout=1200)
+        if child.returncode != -signal.SIGTERM:
+            _log(f"FAILED: child exited {child.returncode}, expected "
+                 f"SIGTERM ({-signal.SIGTERM})")
+            return 1
+        n_journaled = len(glob.glob(os.path.join(ckpt, "bucket_*.json")))
+        if n_journaled < 1:
+            _log("FAILED: child journaled no bucket before SIGTERM")
+            return 1
+        _log(f"child SIGTERM'd with {n_journaled} bucket(s) journaled; "
+             "resuming at mesh=2")
+        agg2, recs2, res2 = _run(longs, srs, mesh_shards=2,
+                                 checkpoint_dir=ckpt, resume=True)
+        replays = sum(_counter(res2, "checkpoint_journal_replays")
+                      .values())
+        if replays < 1:
+            _log("FAILED: resume at mesh=2 replayed nothing from the "
+                 "mesh=4 journal")
+            return 1
+        if agg2 != agg0 or recs2 != recs0:
+            _log("FAILED: mesh=4-journal -> mesh=2 resume is not "
+                 "byte-identical to baseline")
+            return 1
+        _log(f"resume OK: {replays} bucket(s) replayed across mesh "
+             "shapes, byte-identical aggregate")
+
+    # -- phase 5: leak check ----------------------------------------------
+    lrep = leak.report()
+    if lrep["leaked_bytes"] > 1 << 20:
+        _log(f"FAILED: live-array leak: {lrep}")
+        return 1
+    _log(f"leak check OK: {json.dumps(lrep)}")
+    _log("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

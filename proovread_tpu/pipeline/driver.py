@@ -109,6 +109,31 @@ class PipelineConfig:
     # fault-injection spec (testing/faults.py grammar); None reads the
     # PROOVREAD_FAULT env var
     fault_spec: Optional[str] = None
+    # -- multi-chip mesh (parallel/dmesh.py; docs/RESILIENCE.md "Mesh
+    # fault domains") ----------------------------------------------------
+    # shard the iteration passes of every bucket over this many devices
+    # (the dp axis). None/0/1 = single-device (the historical path). The
+    # mesh rungs sit above the per-bucket ladder: a chip-level fault
+    # drops the failed shard, rebalances its reads onto survivors and
+    # recompiles, down to single-device and then the host rungs. NONE of
+    # the mesh knobs enter the checkpoint fingerprint — a journal
+    # written under one mesh shape resumes byte-identically under
+    # another (resilience.run_fingerprint).
+    mesh_shards: Optional[int] = None
+    # static per-shard candidate budget of the sharded step, in units of
+    # device_chunk (a shard_map body cannot size its chunk loop from the
+    # traced candidate count). A pass that WOULD overflow it is a
+    # 'cap_overflow' mesh fault: the bucket retreats to the single-device
+    # rung (dynamic chunks, never truncates), so a bound cap can degrade
+    # parallelism but never change output — which is why this knob stays
+    # out of the checkpoint fingerprint
+    mesh_chunks_per_shard: int = 2
+    # soft wall-clock budget per SHARDED iteration pass, in seconds: the
+    # psum makes every chip wait on the slowest, so a straggling shard
+    # parks the host in the step's KPI fetch — this deadline turns that
+    # hang into a classified 'straggler' mesh fault (thread-safe,
+    # resilience.soft_deadline). None = no per-pass budget.
+    mesh_pass_timeout: Optional[float] = None
 
 
 @dataclass
@@ -199,6 +224,21 @@ def _declare_metrics(reg) -> None:
     c("bases_processed", "bases", "long-read bases corrected")
     c("jax_retraces", "traces",
       "Python retraces of jitted pipeline functions")
+    # mesh fault-domain KPIs (parallel/dmesh.py; the schema is declared
+    # independently in obs/validate.py:MESH_COUNTERS/MESH_GAUGES and a
+    # lint test keeps the two from drifting, QC-style)
+    c("mesh_passes", "passes",
+      "iteration passes executed through the sharded mesh step")
+    c("mesh_faults", "faults",
+      "mesh-rung faults, by kind and implicated shard")
+    c("mesh_demotions", "demotions",
+      "mesh-ladder demotions, by destination rung")
+    reg.gauge("mesh_shards_configured", "shards",
+              "dp shards the run was configured with")
+    reg.gauge("mesh_shards_active", "shards",
+              "dp shards alive after mesh-ladder exclusions")
+    reg.gauge("mesh_rebalanced_reads", "reads",
+              "reads moved between shards by the last rebalance")
     reg.histogram("bucket_seconds", "s", "wall time per length bucket")
     # correction-QC aggregate gauges (obs/qc.py): pre-declared so a run
     # without --qc-out still exposes the schema (zero-valued)
@@ -450,6 +490,10 @@ class Pipeline:
             self._sr_lens = np.asarray([len(r) for r in short_records])
 
         # -- resilience setup (pipeline/resilience.py) --------------------
+        # per-bucket mesh placement of the PREVIOUS attempt (rebalance
+        # accounting); scoped to one run — a reused Pipeline must not
+        # report a fresh run's first placement as a "rebalance"
+        self._mesh_prev_shard: Dict[int, np.ndarray] = {}
         import os as _os
 
         from proovread_tpu.pipeline.resilience import (CheckpointJournal,
@@ -659,6 +703,26 @@ class Pipeline:
             return cfg.device_chunk
         return max(128, (cfg.device_chunk // level.chunk_div // 128) * 128)
 
+    def _mesh_shards_effective(self) -> int:
+        """Configured mesh width, clamped to what this process can
+        actually shard over. Flex mode stays single-device: its per-pass
+        haplo budget refresh cannot ride the sharded step."""
+        import jax
+        cfg = self.config
+        n = int(cfg.mesh_shards or 0)
+        if n < 2:
+            return 0
+        if cfg.haplo_coverage is not None:
+            log.warning("mesh: flex mode (haplo-coverage) runs "
+                        "single-device; ignoring mesh_shards=%d", n)
+            return 0
+        have = jax.device_count()
+        if have < n:
+            log.warning("mesh: only %d device(s) visible; clamping "
+                        "mesh_shards %d -> %d", have, n, have)
+            n = have
+        return n if n >= 2 else 0
+
     def _run_bucket_resilient(self, gi, batch_recs, sr_dev, short_records,
                               sampler, coverage, min_sr_len, reports, Lp):
         """One length bucket under the fault boundary: on a device fault
@@ -667,10 +731,22 @@ class Pipeline:
         demotion in the report stream. Non-device exceptions propagate.
         Each attempt restarts the bucket from its original records with
         the sampler rotation rewound, so a retried bucket sees exactly the
-        short-read subsets a fresh run at that rung would."""
+        short-read subsets a fresh run at that rung would.
+
+        With a mesh configured (``cfg.mesh_shards``), mesh rungs sit
+        ABOVE this walk: ``mesh-dpN`` -> (on an attributable
+        ``device_lost``/``straggler``) the SAME rung re-entered at
+        ``mesh-dp(N-1)`` with the failed shard excluded and its reads
+        rebalanced onto survivors — a chip is a fault domain, losing one
+        costs a rebalance + recompile, not the bucket — until fewer than
+        2 shards survive; every other mesh fault (``shard_oom``,
+        ``collective_timeout``, an unattributable straggler) retreats
+        directly to the single-device rungs below."""
         from proovread_tpu.ops import pileup_kernel
         from proovread_tpu.pipeline.resilience import (LADDER,
                                                        classify_fault,
+                                                       classify_mesh_fault,
+                                                       mesh_level,
                                                        soft_deadline)
 
         cfg = self.config
@@ -697,10 +773,21 @@ class Pipeline:
             levels = [lv for lv in levels
                       if (lv.host or lv.chunk_div == 1
                           or self._level_chunk(lv) != cfg.device_chunk)]
+        mesh_n = self._mesh_shards_effective()
+        if mesh_n >= 2:
+            # the mesh rung tops the walk; with the ladder off it IS the
+            # walk (fail fast on the first mesh fault, like every rung)
+            levels = ([mesh_level(mesh_n)] + levels if cfg.ladder
+                      else [mesh_level(mesh_n)])
+        # ORIGINAL shard ordinals the mesh ladder has excluded for this
+        # bucket; the shrunken rung's device list is derived from it
+        mesh_failed: List[int] = []
         reg = obs.metrics.current()
         qc_rec = obs.qc.current()
         qc_ids = [r.id for r in batch_recs] if qc_rec is not None else []
-        for li, level in enumerate(levels):
+        li = 0
+        while li < len(levels):
+            level = levels[li]
             n_rep0 = len(reports)
             sampler_fc0 = sampler.first_chunk
             m_snap = reg.snapshot() if reg is not None else None
@@ -721,12 +808,27 @@ class Pipeline:
                         return self._run_batch_device(
                             batch_recs, sr_dev, len(short_records),
                             sampler, coverage, min_sr_len, reports, Lp,
-                            gi=gi, level=level)
+                            gi=gi, level=level, mesh_failed=mesh_failed,
+                            mesh_n0=mesh_n)
                     finally:
                         pileup_kernel.force_windowed(False)
             except Exception as e:                      # noqa: BLE001
-                kind = classify_fault(e)
-                if kind is None or not cfg.ladder or li == len(levels) - 1:
+                mesh_kind = classify_mesh_fault(e)
+                kind = mesh_kind[0] if mesh_kind else classify_fault(e)
+                # an attributable chip loss/straggle with >= 2 survivors
+                # re-enters the mesh rung shrunken by the failed shard;
+                # this never consumes a rung index, and it terminates:
+                # each shrink permanently excludes one original shard
+                shard = mesh_kind[1] if mesh_kind else None
+                shrink = (cfg.ladder and level.mesh >= 2
+                          and mesh_kind is not None
+                          and mesh_kind[0] in ("device_lost", "straggler")
+                          and shard is not None
+                          and 0 <= shard < mesh_n
+                          and shard not in mesh_failed
+                          and level.mesh - 1 >= 2)
+                if kind is None or not cfg.ladder or (
+                        li == len(levels) - 1 and not shrink):
                     raise
                 # drop the failed attempt's partial pass reports and rewind
                 # the sampler AND the KPI counters so the retry reproduces
@@ -741,20 +843,42 @@ class Pipeline:
                     # rewind with the reports/KPIs — the retried rung
                     # rebuilds them from scratch
                     qc_rec.restore(qc_ids, qc_snap)
-                nxt = levels[li + 1]
+                if shrink:
+                    mesh_failed.append(shard)
+                    nxt = mesh_level(level.mesh - 1)
+                    levels[li] = nxt
+                else:
+                    nxt = levels[li + 1]
+                    li += 1
                 obs.metrics.counter("device_faults", unit="faults").inc(
                     1, kind=kind)
                 obs.metrics.counter(
                     "resilience_demotions", unit="demotions").inc(
                     1, to_rung=nxt.name)
+                if mesh_n >= 2 and (mesh_kind is not None
+                                    or level.mesh >= 2):
+                    # shard-attributed mesh accounting (obs/validate.py
+                    # MESH_COUNTERS schema): which chip, which fault,
+                    # where the bucket landed. Gated on a CONFIGURED
+                    # mesh: a meshless run whose RuntimeError happens to
+                    # carry a device-lost/collective mark must not book
+                    # phantom mesh events
+                    obs.metrics.counter("mesh_faults", unit="faults").inc(
+                        1, kind=kind,
+                        shard=(str(shard) if shard is not None else "?"))
+                    obs.metrics.counter(
+                        "mesh_demotions", unit="demotions").inc(
+                        1, to_rung=nxt.name)
+                at = (f"shard {shard} of rung '{level.name}'"
+                      if shard is not None else f"rung '{level.name}'")
                 head = (str(e).splitlines() or [""])[0][:160]
-                note = (f"{kind} fault at rung '{level.name}': demoted "
+                note = (f"{kind} fault at {at}: demoted "
                         f"bucket {gi} to '{nxt.name}' — {head}")
                 reports.append(TaskReport(f"demote-b{gi}", 0.0, 0, 0,
                                           note=note))
                 log.warning(
-                    "bucket %d: %s fault at rung %r — retrying at %r (%s)",
-                    gi, kind, level.name, nxt.name, head)
+                    "bucket %d: %s fault at %s — retrying at %r (%s)",
+                    gi, kind, at, nxt.name, head)
         raise AssertionError("unreachable: ladder exhausted without raise")
 
     def _scan_sr_all(self, short_records):
@@ -767,7 +891,8 @@ class Pipeline:
 
     def _run_batch_device(self, batch_recs, sr_dev, n_short, sampler,
                           coverage, min_sr_len, reports, Lp,
-                          gi: int = 0, level=None):
+                          gi: int = 0, level=None, mesh_failed=(),
+                          mesh_n0: int = 0):
         """Device-resident iteration loop: per pass, only the masked-% KPI
         and the candidate count touch the host; corrected reads come back
         once, after the finish pass (pipeline/dcorrect.py).
@@ -775,7 +900,11 @@ class Pipeline:
         ``gi``: bucket ordinal (fault-injection addressing + demotion
         notes). ``level``: the resilience-ladder rung this attempt runs at
         (None = the top 'fused' rung): ``level.fused`` gates the fused
-        multi-pass program, ``level.chunk_div`` divides ``device_chunk``."""
+        multi-pass program, ``level.chunk_div`` divides ``device_chunk``,
+        ``level.mesh >= 2`` routes the iteration passes through the
+        sharded mesh step (parallel/dmesh.py) over the alive shards —
+        ``mesh_n0`` original shards minus the ``mesh_failed`` ordinals
+        the mesh ladder has excluded for this bucket."""
         import jax
         import jax.numpy as jnp
         from proovread_tpu.pipeline.dcorrect import (
@@ -790,8 +919,15 @@ class Pipeline:
         if faults is not None and faults.active:
             faults.check(gi)                    # bucket-entry site
         B0 = len(batch_recs)
+        mesh_n = int(getattr(level, "mesh", 0) or 0)
+        rows = self._batch_rows(B0)
+        if mesh_n >= 2:
+            # every shard carries rows/mesh reads (a shard_map body needs
+            # identical per-shard shapes); the 8-base pad sentinels seed
+            # nothing, so they are near-zero placement load
+            rows = -(-rows // mesh_n) * mesh_n
         pad_recs = [SeqRecord(f"_pad{i}", "A" * 8)
-                    for i in range(self._batch_rows(B0) - B0)]
+                    for i in range(rows - B0)]
         lr = pack_reads(list(batch_recs) + pad_recs, pad_len=Lp)
         dc = self._get_dc(self._level_chunk(level))
         codes = jnp.asarray(lr.codes)
@@ -876,7 +1012,144 @@ class Pipeline:
 
         cns = _iter_cns()
         flex_budget = None
-        if cfg.haplo_coverage is not None:
+        mesh_on = mesh_n >= 2
+        if mesh_on:
+            # -- sharded iteration loop (parallel/dmesh.py): passes 1..n
+            # run through the compile chokepoint's mesh step, with reads
+            # candidate-balanced over the alive shards and the KPI psums
+            # as the only interconnect traffic. The finish pass below
+            # stays single-device (it collects alignments for the host
+            # chimera scan). The fused multi-pass program never runs
+            # here: each pass is its own small program, so a shrunken
+            # retry after a shard loss recompiles cheaply, and per-pass
+            # QC rows come back with each step's KPI fetch.
+            from proovread_tpu.parallel.dmesh import (build_sharded_step,
+                                                      make_dp_mesh)
+            from proovread_tpu.parallel.plan import (balance_placement,
+                                                     moved_reads,
+                                                     shard_of_rows)
+            from proovread_tpu.pipeline.resilience import soft_deadline
+            from proovread_tpu.testing.faults import (MeshCapExceeded,
+                                                      ShardStraggler)
+
+            alive = [s for s in range(mesh_n0) if s not in mesh_failed]
+            devs = jax.devices()[:mesh_n0]
+            mesh = make_dp_mesh(devices=[devs[s] for s in alive])
+            # candidate-balanced placement (not a naive B/n split): LPT
+            # over read lengths, the candidate-load proxy. The state
+            # arrays live in placement order for the whole loop and are
+            # un-permuted ONCE at the end — per-read results are exact
+            # under any placement, so the permutation is free to change
+            # between attempts (that change IS the rebalance).
+            order = balance_placement(lr.lengths, len(alive))
+            inv = np.argsort(order).astype(np.int32)
+            qc_sel = np.flatnonzero(order < B0)
+            qc_row_ids = [lr.ids[int(order[j])] for j in qc_sel]
+            # rows the single-device run would also carry (its base pads
+            # included): only these enter the masked-fraction psums, so
+            # the shortcut decision divides exactly the sums every other
+            # rung divides — the mesh-rounding surplus pads do not
+            row_valid = jnp.asarray(order < self._batch_rows(B0))
+            cur_shard = shard_of_rows(order, len(alive))
+            moved = moved_reads(self._mesh_prev_shard.get(gi),
+                                cur_shard, B0)
+            self._mesh_prev_shard[gi] = cur_shard
+            m = obs.metrics
+            m.gauge("mesh_shards_configured", unit="shards").set(mesh_n0)
+            m.gauge("mesh_shards_active", unit="shards").set(len(alive))
+            m.gauge("mesh_rebalanced_reads", unit="reads").set(moved)
+            log.info("mesh: bucket %d over %d shard(s)%s — %d read(s) "
+                     "rebalanced", gi, len(alive),
+                     (f" (lost: {sorted(mesh_failed)})"
+                      if mesh_failed else ""), moved)
+            perm = jnp.asarray(order)
+            codes, qual, lengths = codes[perm], qual[perm], lengths[perm]
+            mask_cols = jnp.zeros(codes.shape, bool)
+            it = 1
+            while it <= cfg.n_iterations:
+                task = f"bwa-{cfg.mode[:2]}-{it}"
+                step = build_sharded_step(
+                    mesh, _align_params_cfg(cfg, it), cns,
+                    chunks_per_shard=cfg.mesh_chunks_per_shard,
+                    chunk=dc.chunk, seed_stride=cfg.seed_stride,
+                    interpret=dc.interpret, collect_qc=qc_on)
+                with obs.span(task, cat="pass", bucket=gi,
+                              mesh=len(alive)):
+                    _inj(it)
+                    if faults is not None and faults.active:
+                        for s in alive:     # dropped shards never refire
+                            faults.check_mesh(s, it)
+                    sel = sampler.select(n_short, coverage,
+                                         cfg.sr_coverage) \
+                        if cfg.sampling else np.arange(n_short)
+                    qcq, rcq, qq, qlen = sr_dev.take(sel)
+                    pvec = mask_params_vec(_mask_p(it))
+                    # the psum parks the host in this fetch until the
+                    # SLOWEST shard finishes — the per-pass deadline is
+                    # what turns a straggling chip into a classified
+                    # mesh fault instead of an unbounded hang
+                    with soft_deadline(
+                            cfg.mesh_pass_timeout,
+                            what=f"bucket {gi} pass {it} (mesh)",
+                            exc=ShardStraggler):
+                        out = step(codes, qual, lengths, mask_cols,
+                                   row_valid, qcq, rcq, qq, qlen, pvec)
+                        codes, qual, lengths, mask_cols = out[:4]
+                        if qc_on:
+                            scal, (mrow, nlen, ed, up) = jax.device_get(
+                                (out[4:10],
+                                 (out[10], out[2], out[11], out[12])))
+                        else:
+                            scal = jax.device_get(out[4:10])
+                    masked_i, total_i, n_adm, n_elig, n_cand, n_drop = \
+                        (int(v) for v in scal)
+                    if n_drop > 0:
+                        # the static per-shard cap WOULD have truncated
+                        # candidates — truncated output is mesh-shape-
+                        # dependent, so retreat to the single-device rung
+                        # (dynamic chunks, never truncates) rather than
+                        # silently correct differently than a resume at
+                        # another shape would
+                        raise MeshCapExceeded(
+                            f"sharded pass {it} would drop {n_drop} "
+                            f"candidate(s) at the per-shard cap "
+                            f"({cfg.mesh_chunks_per_shard} x {dc.chunk} "
+                            "rows) — raise mesh_chunks_per_shard or "
+                            "device_chunk")
+                    if qc_on:
+                        qc_rec.record_pass(qc_row_ids, mrow[qc_sel],
+                                           nlen[qc_sel])
+                        qc_rec.record_edits(qc_row_ids, ed[qc_sel],
+                                            up[qc_sel])
+                    # the fraction divides the psum'd integer sums on the
+                    # host (f32, like every rung) — the shortcut decision
+                    # stays mesh-shape-invariant
+                    new_frac = float(np.float32(masked_i)
+                                     / np.float32(max(total_i, 1)))
+                    gain = new_frac - masked_frac
+                    masked_frac = new_frac
+                    d_cov = max(0, n_elig - n_adm)
+                    _record_report(reports, TaskReport(
+                        task, masked_frac, n_cand, n_adm,
+                        n_dropped_cov=d_cov))
+                    obs.metrics.counter("mesh_passes",
+                                        unit="passes").inc()
+                    log.info("%s: masked %.1f%% (mesh:%d)%s", task,
+                             masked_frac * 100, len(alive),
+                             _drop_sfx(0, d_cov))
+                it += 1
+                if (masked_frac > cfg.mask_shortcut_frac
+                        or gain < cfg.mask_min_gain_frac):
+                    _shortcut(masked_frac, gain)
+                    break
+            # back to natural row order for the single-device finish
+            inv_dev = jnp.asarray(inv)
+            codes, qual, lengths = (codes[inv_dev], qual[inv_dev],
+                                    lengths[inv_dev])
+            mask_cols = None
+            first_fused = cfg.n_iterations + 1       # fused loop skipped
+            ap_rest = _align_params_cfg(cfg, 2)
+        elif cfg.haplo_coverage is not None:
             if cfg.haplo_coverage > 0:
                 flex_budget = jnp.full(
                     codes.shape[0], cfg.haplo_coverage * cns.bin_size,
@@ -950,7 +1223,7 @@ class Pipeline:
             _align_params_cfg(cfg, i) == ap_rest
             for i in range(2, cfg.n_iterations + 1))
         n_cand_seen = None
-        if cfg.haplo_coverage is None:
+        if cfg.haplo_coverage is None and not mesh_on:
             # pass 1 always runs eagerly (dynamic chunk count): it LEARNS
             # the batch's candidate scale, which sizes the fused program's
             # static chunk count below — provisioning the fused scan from

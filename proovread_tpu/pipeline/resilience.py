@@ -68,7 +68,8 @@ import numpy as np
 from proovread_tpu.obs import metrics as obs_metrics
 from proovread_tpu.io.records import SeqRecord
 from proovread_tpu.testing.faults import (BucketTimeout, InjectedFault,
-                                          WallClockExceeded)
+                                          InjectedMeshFault, MESH_KINDS,
+                                          ShardStraggler, WallClockExceeded)
 
 log = logging.getLogger("proovread_tpu")
 
@@ -86,6 +87,14 @@ _COMPILE_MARKS = ("remote_compile", "XLA compilation", "Compilation failure",
                   "compile", "INTERNAL")
 _KERNEL_MARKS = ("Mosaic", "Pallas", "mosaic")
 _TIMEOUT_MARKS = ("DEADLINE_EXCEEDED",)
+# mesh-rung fault classes (docs/RESILIENCE.md "Mesh fault domains"): a chip
+# dropping off the mesh, and a hung cross-chip collective. Matched BEFORE
+# the single-chip marks — "device lost ... INTERNAL" is a mesh event, not
+# a compile failure.
+_DEVICE_LOST_MARKS = ("device lost", "Device lost", "device is gone",
+                      "failed to query device")
+_COLLECTIVE_MARKS = ("collective", "all-reduce", "AllReduce", "NCCL",
+                     "cross-replica")
 
 
 def classify_fault(exc: BaseException) -> Optional[str]:
@@ -98,6 +107,12 @@ def classify_fault(exc: BaseException) -> Optional[str]:
     fault types. A ``ValueError`` from a real shape bug never matches."""
     if isinstance(exc, WallClockExceeded):
         return None     # run-level budget breach: abort the run, not demote
+    if isinstance(exc, InjectedMeshFault):
+        # mesh kinds keep their own label: the ladder treats them like any
+        # other device fault (non-None = demotable), while the metrics and
+        # demotion notes stay attributable to the mesh event that caused
+        # them even when one escapes past the mesh rungs
+        return exc.kind
     if isinstance(exc, BucketTimeout):
         return "timeout"
     if isinstance(exc, InjectedFault):
@@ -110,11 +125,33 @@ def classify_fault(exc: BaseException) -> Optional[str]:
     if not isinstance(exc, RuntimeError):
         return None
     msg = str(exc)
-    for marks, kind in ((_TIMEOUT_MARKS, "timeout"), (_OOM_MARKS, "oom"),
+    for marks, kind in ((_DEVICE_LOST_MARKS, "device_lost"),
+                        (_COLLECTIVE_MARKS, "collective_timeout"),
+                        (_TIMEOUT_MARKS, "timeout"), (_OOM_MARKS, "oom"),
                         (_KERNEL_MARKS, "kernel"),
                         (_COMPILE_MARKS, "compile")):
         if any(s in msg for s in marks):
             return kind
+    return None
+
+
+def classify_mesh_fault(exc: BaseException):
+    """``(kind, shard)`` for faults the MESH ladder handles specially, or
+    ``None`` for everything else. ``kind`` is one of
+    ``testing.faults.MESH_KINDS``; ``shard`` is the implicated ORIGINAL
+    shard ordinal, or ``None`` when the fault cannot name one (a real
+    straggler deadline, a hung collective) — an unattributable mesh fault
+    retreats to single-device instead of guessing which chip to drop."""
+    if isinstance(exc, InjectedMeshFault):
+        return exc.kind, exc.shard
+    if isinstance(exc, ShardStraggler):
+        return "straggler", exc.shard
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        if any(s in msg for s in _DEVICE_LOST_MARKS):
+            return "device_lost", None
+        if any(s in msg for s in _COLLECTIVE_MARKS):
+            return "collective_timeout", None
     return None
 
 
@@ -279,6 +316,18 @@ class LadderLevel:
     chunk_div: int = 1         # device_chunk divisor
     windowed: bool = False     # force the windowed-DMA pileup kernel
     host: bool = False         # host engine="scan" path
+    # >= 2: run the iteration passes through the sharded mesh step over
+    # this many alive shards (parallel/dmesh.py). The mesh rungs sit
+    # ABOVE this per-bucket ladder: full-mesh -> shrunken-mesh (drop the
+    # failed shard, rebalance, recompile; the driver re-enters the rung
+    # with mesh-1 while >= 2 shards survive) -> the single-device rungs
+    # below (docs/RESILIENCE.md "Mesh fault domains")
+    mesh: int = 0
+
+
+def mesh_level(n_shards: int) -> LadderLevel:
+    """The mesh rung over ``n_shards`` alive shards."""
+    return LadderLevel(f"mesh-dp{n_shards}", mesh=n_shards)
 
 
 LADDER: Tuple[LadderLevel, ...] = (
@@ -297,7 +346,14 @@ def run_fingerprint(cfg, long_ids: Sequence[str], n_short: int) -> str:
     """Identity of a run for journal validity: the inputs (long-read ids +
     short-read count) and every config knob that changes corrected output.
     A mismatched fingerprint means the journal answers a different question
-    — it is ignored (with a warning), never silently replayed."""
+    — it is ignored (with a warning), never silently replayed.
+
+    The mesh knobs (``mesh_shards``, ``mesh_chunks_per_shard``,
+    ``mesh_pass_timeout``) are deliberately ABSENT: journal entries are
+    keyed by read content (:func:`bucket_key`), never by shard slot, and
+    per-shard execution is exact over reads — so a journal written at
+    mesh=4 must replay byte-identically at mesh=2 or on a single chip
+    (mesh-shape-invariant resume; pinned by tests/test_dmesh_faults.py)."""
     knobs = {
         "mode": cfg.mode, "n_iterations": cfg.n_iterations,
         "sr_coverage": cfg.sr_coverage,

@@ -105,6 +105,11 @@ def _pipeline_config(cfg: Config, mode: str, tasks: Sequence[str],
         ladder=bool(int(1 if cfg.get("resilience-ladder") is None
                         else cfg.get("resilience-ladder"))),
         fault_spec=cfg.get("fault-spec"),
+        mesh_shards=(int(cfg.get("mesh-shards"))
+                     if cfg.get("mesh-shards") else None),
+        mesh_chunks_per_shard=int(cfg.get("mesh-chunks-per-shard") or 2),
+        mesh_pass_timeout=(float(cfg.get("mesh-pass-timeout"))
+                           if cfg.get("mesh-pass-timeout") else None),
     )
 
 

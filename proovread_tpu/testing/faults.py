@@ -16,16 +16,24 @@ Spec grammar (semicolon- or comma-separated rules)::
 
     <kind>@b<bucket>[.p<pass>][x<count>]        device-site rules
     <kind>@j<job>[x<count>]                     job-site rules (serving)
+    <kind>@d<shard>[.p<pass>][x<count>]         mesh-site rules (multi-chip)
     <kind>@*[.p<pass>][x<count>]
 
     kind    device sites: compile | oom | timeout | kernel
             job sites:    parse | worker | deadline | quota | journal
+            mesh sites:   device_lost | shard_oom | straggler |
+                          collective_timeout
     bucket  0-based length-bucket index ('*' = any bucket)
     job     0-based job SUBMISSION ordinal within one server lifetime
             ('*' = any job); only valid for the job-site kinds
+    shard   0-based shard ordinal in the ORIGINAL mesh ('*' = any alive
+            shard); only valid for the mesh-site kinds. A shard the mesh
+            ladder already dropped is never visited again, so an
+            unlimited rule cannot loop the shrink rung forever.
     pass    1..n_iterations; n_iterations+1 addresses the finish pass.
             Omitted = the rule fires at ANY device site of the bucket,
-            including the bucket-entry site.
+            including the bucket-entry site. For mesh sites: the
+            iteration whose sharded step the fault interrupts.
     count   max number of firings (default: unlimited — a rule keeps
             firing on every ladder retry, which is what walks a bucket
             down to the host-scan rung)
@@ -33,7 +41,9 @@ Spec grammar (semicolon- or comma-separated rules)::
 Examples: ``compile@b0.p2`` (compile failure at bucket 0, pass 2, every
 device attempt), ``oom@b1`` (OOM on any device work in bucket 1),
 ``timeout@b2.p1x1`` (one single injected timeout), ``worker@j3x1`` (the
-correction worker dies once while a wave containing job 3 is mid-flight).
+correction worker dies once while a wave containing job 3 is mid-flight),
+``device_lost@d1.p2`` (shard 1's chip dies at iteration 2 of every mesh
+attempt — the headline ``make dmesh-smoke`` scenario).
 
 Device faults are only raised from device-path sites, so the host
 ``engine="scan"`` rung — and the scan engine itself — always completes,
@@ -63,6 +73,8 @@ log = logging.getLogger("proovread_tpu")
 
 KINDS = ("compile", "oom", "timeout", "kernel")
 JOB_KINDS = ("parse", "worker", "deadline", "quota", "journal")
+MESH_KINDS = ("device_lost", "shard_oom", "straggler",
+              "collective_timeout")
 
 
 class InjectedFault(RuntimeError):
@@ -86,6 +98,82 @@ class InjectedKernelFault(InjectedFault):
 class BucketTimeout(RuntimeError):
     """A bucket exceeded its wall-clock budget. Raised by the injected
     ``timeout`` kind and by ``resilience.soft_deadline``'s SIGALRM handler."""
+
+
+class ShardStraggler(BucketTimeout):
+    """A sharded iteration step exceeded its per-pass soft deadline
+    (``PipelineConfig.mesh_pass_timeout``) — the host-side wait on the
+    step's KPI fetch is where a straggling chip parks the whole mesh.
+
+    A REAL deadline firing cannot name the slow chip (the collective
+    blocks on all of them), so ``shard`` is None and the mesh ladder
+    retreats to single-device; the INJECTED ``straggler`` kind carries
+    the shard it simulates, so the shrink rung can drop exactly that
+    shard. Subclasses :class:`BucketTimeout` so a straggler that escapes
+    the mesh rung still classifies as an ordinary ``timeout`` for the
+    per-bucket ladder."""
+
+    def __init__(self, *args, shard=None):
+        super().__init__(*args)
+        self.shard = shard
+
+
+class InjectedMeshFault(InjectedFault):
+    """Base class for injected MESH faults (``@d<shard>`` sites). A
+    RuntimeError like the other device faults — the per-bucket ladder may
+    absorb one that escapes the mesh rungs — but additionally carries the
+    implicated ``shard`` and its ``kind``, which is what lets the mesh
+    ladder drop the right chip and attribute the demotion
+    (``resilience.classify_mesh_fault``)."""
+
+    kind = "mesh"
+
+    def __init__(self, *args, shard=None):
+        super().__init__(*args)
+        self.shard = shard
+
+
+class InjectedDeviceLost(InjectedMeshFault):
+    """Stands in for a chip dropping off the mesh mid-step (ICI link
+    down, chip reset — the pod-slice analog of a killed chunk process)."""
+
+    kind = "device_lost"
+
+
+class InjectedShardOOM(InjectedMeshFault):
+    """Stands in for ONE shard exhausting its HBM (skewed candidate load;
+    the other shards were fine)."""
+
+    kind = "shard_oom"
+
+
+class InjectedStraggler(InjectedMeshFault):
+    """Stands in for one chip running the step far slower than the rest
+    (thermal throttling, preemption) — the psum makes everyone wait."""
+
+    kind = "straggler"
+
+
+class InjectedCollectiveTimeout(InjectedMeshFault):
+    """Stands in for a hung cross-chip collective (interconnect fault,
+    not attributable to a single chip)."""
+
+    kind = "collective_timeout"
+
+
+class MeshCapExceeded(InjectedMeshFault):
+    """NOT injected, despite the base class: raised by the driver's mesh
+    loop when a sharded pass reports ``n_dropped_cap > 0`` — the static
+    per-shard candidate budget (``mesh_chunks_per_shard * chunk``) would
+    have truncated candidates, and truncated output is mesh-shape-
+    DEPENDENT (total capacity scales with shard count). Subclassing
+    :class:`InjectedMeshFault` puts it on the mesh classification path:
+    ``kind`` is outside the shrinkable set, so the bucket retreats to the
+    single-device rung, whose dynamic chunk count never truncates — the
+    mesh-shape-invariance guarantee holds unconditionally, and the knob
+    can stay out of the checkpoint fingerprint."""
+
+    kind = "cap_overflow"
 
 
 class InjectedJobFault(Exception):
@@ -126,7 +214,23 @@ class WallClockExceeded(Exception):
     result), not demote the bucket and keep going unbounded."""
 
 
-def make_fault(kind: str, where: str) -> Exception:
+def make_fault(kind: str, where: str, shard=None) -> Exception:
+    if kind == "device_lost":
+        return InjectedDeviceLost(
+            f"device lost: shard {shard} dropped off the mesh "
+            f"(injected at {where})", shard=shard)
+    if kind == "shard_oom":
+        return InjectedShardOOM(
+            f"RESOURCE_EXHAUSTED on shard {shard} (injected at {where})",
+            shard=shard)
+    if kind == "straggler":
+        return InjectedStraggler(
+            f"shard {shard} straggling past the mesh pass deadline "
+            f"(injected at {where})", shard=shard)
+    if kind == "collective_timeout":
+        return InjectedCollectiveTimeout(
+            f"DEADLINE_EXCEEDED: cross-chip collective hung "
+            f"(injected at {where})", shard=shard)
     if kind == "compile":
         return InjectedCompileError(
             f"XLA compilation failure (injected at {where})")
@@ -156,7 +260,8 @@ def make_fault(kind: str, where: str) -> Exception:
 
 
 _RULE_RE = re.compile(
-    r"^(?P<kind>[a-z]+)@(?:b(?P<bucket>\d+)|j(?P<job>\d+)|(?P<any>\*))"
+    r"^(?P<kind>[a-z_]+)@(?:b(?P<bucket>\d+)|j(?P<job>\d+)"
+    r"|d(?P<shard>\d+)|(?P<any>\*))"
     r"(?:\.p(?P<pass>\d+))?(?:x(?P<count>\d+))?$")
 
 
@@ -167,10 +272,11 @@ class FaultRule:
     pass_: Optional[int]         # None = any site of the bucket
     count: Optional[int]         # None = unlimited firings
     job: Optional[int] = None    # job-site rules: submission ordinal
+    shard: Optional[int] = None  # mesh-site rules: original shard ordinal
     fired: int = 0
 
     def matches(self, bucket: int, pass_: Optional[int]) -> bool:
-        if self.kind in JOB_KINDS:
+        if self.kind in JOB_KINDS or self.kind in MESH_KINDS:
             return False
         if self.count is not None and self.fired >= self.count:
             return False
@@ -186,6 +292,17 @@ class FaultRule:
         if self.count is not None and self.fired >= self.count:
             return False
         if self.job is not None and self.job != job:
+            return False
+        return True
+
+    def matches_mesh(self, shard: int, pass_: Optional[int]) -> bool:
+        if self.kind not in MESH_KINDS:
+            return False
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.shard is not None and self.shard != shard:
+            return False
+        if self.pass_ is not None and self.pass_ != pass_:
             return False
         return True
 
@@ -212,23 +329,30 @@ class FaultPlan:
                     "device kinds, kind@jN[xK] / kind@*[xK] for job "
                     "kinds)")
             kind = m.group("kind")
-            if kind not in KINDS and kind not in JOB_KINDS:
+            if (kind not in KINDS and kind not in JOB_KINDS
+                    and kind not in MESH_KINDS):
                 raise ValueError(
                     f"unknown fault kind {kind!r} in {part!r} "
-                    f"(known: {', '.join(KINDS + JOB_KINDS)})")
-            if kind in JOB_KINDS and (m.group("bucket") or m.group("pass")):
+                    f"(known: {', '.join(KINDS + JOB_KINDS + MESH_KINDS)})")
+            if kind in JOB_KINDS and (m.group("bucket") or m.group("pass")
+                                      or m.group("shard")):
                 raise ValueError(
                     f"job-site kind {kind!r} takes @jN or @* addressing, "
-                    f"not bucket/pass sites ({part!r})")
-            if kind in KINDS and m.group("job"):
+                    f"not bucket/pass/shard sites ({part!r})")
+            if kind in KINDS and (m.group("job") or m.group("shard")):
                 raise ValueError(
                     f"device-site kind {kind!r} takes @bN or @* "
-                    f"addressing, not @j job sites ({part!r})")
+                    f"addressing, not @j/@d sites ({part!r})")
+            if kind in MESH_KINDS and (m.group("bucket") or m.group("job")):
+                raise ValueError(
+                    f"mesh-site kind {kind!r} takes @dN or @* addressing, "
+                    f"not @b/@j sites ({part!r})")
             rules.append(FaultRule(
                 kind=kind,
                 bucket=(int(m.group("bucket")) if m.group("bucket")
                         else None),
                 job=int(m.group("job")) if m.group("job") else None,
+                shard=int(m.group("shard")) if m.group("shard") else None,
                 pass_=int(m.group("pass")) if m.group("pass") else None,
                 count=int(m.group("count")) if m.group("count") else None))
         return cls(rules)
@@ -271,6 +395,22 @@ class FaultPlan:
         ``job`` is the submission ordinal within one server lifetime."""
         if self.fires_job(job, site):
             raise make_fault(site, f"job {job}")
+
+    def check_mesh(self, shard: int, pass_: Optional[int] = None) -> None:
+        """Raise the injected mesh fault if a rule matches this
+        ``(shard, iteration)`` site. Called by the driver's mesh loop for
+        each ALIVE shard before launching the sharded step — a shard the
+        mesh ladder already dropped is never offered, which is what keeps
+        unlimited ``@*`` rules from re-firing forever."""
+        for r in self.rules:
+            if r.matches_mesh(shard, pass_):
+                r.fired += 1
+                where = (f"shard {shard}" if pass_ is None
+                         else f"shard {shard} iteration {pass_}")
+                log.warning("fault injection: %s at %s (rule fired %d%s)",
+                            r.kind, where, r.fired,
+                            f"/{r.count}" if r.count else "")
+                raise make_fault(r.kind, where, shard=shard)
 
     def check_span(self, bucket: int, pass_lo: int, pass_hi: int) -> None:
         """Raise if any pass index in ``[pass_lo, pass_hi]`` matches — the

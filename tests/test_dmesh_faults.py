@@ -1,0 +1,377 @@
+"""Mesh fault-domain tests (docs/RESILIENCE.md "Mesh fault domains"):
+the ``@d<shard>`` injection grammar, mesh fault classification,
+candidate-balanced placement, the mesh rung ladder (shrink on chip loss,
+retreat on the rest), mesh-shape-invariant output and resume, and the
+drift-guarded ``mesh_*`` metrics schema.
+
+The e2e tests use the shard-EXACT workload family
+(``io/simulate.py:simulate_independent_segments`` — each long read owns
+its genome segment) so "byte-identical across mesh shapes" is a
+meaningful assert, not an approximation (see tests/test_dmesh.py for the
+shared-genome deviation)."""
+
+import json
+
+import numpy as np
+import pytest
+import jax
+
+from proovread_tpu.obs import qc as obs_qc
+from proovread_tpu.obs.validate import (MESH_COUNTERS, MESH_GAUGES,
+                                        ValidationError,
+                                        validate_mesh_metrics)
+from proovread_tpu.parallel.plan import (balance_placement, moved_reads,
+                                         shard_of_rows)
+from proovread_tpu.testing.faults import (FaultPlan, InjectedCollectiveTimeout,
+                                          InjectedDeviceLost, InjectedShardOOM,
+                                          InjectedStraggler, MESH_KINDS,
+                                          ShardStraggler, make_fault)
+
+pytestmark = pytest.mark.faults
+
+MESH_EXC = {"device_lost": InjectedDeviceLost,
+            "shard_oom": InjectedShardOOM,
+            "straggler": InjectedStraggler,
+            "collective_timeout": InjectedCollectiveTimeout}
+
+
+# --------------------------------------------------------------------------
+# unit: @d<shard> grammar + per-kind falsifiability (the injected fault
+# actually fires, with the right class and the right shard attribution)
+# --------------------------------------------------------------------------
+
+class TestMeshFaultGrammar:
+    def test_parse_mesh_rules(self):
+        p = FaultPlan.from_spec(
+            "device_lost@d1.p2x1; straggler@*, shard_oom@d0")
+        assert [(r.kind, r.shard, r.pass_, r.count) for r in p.rules] == [
+            ("device_lost", 1, 2, 1), ("straggler", None, None, None),
+            ("shard_oom", 0, None, None)]
+
+    def test_wrong_site_rejected(self):
+        with pytest.raises(ValueError, match="mesh-site"):
+            FaultPlan.from_spec("device_lost@b0")
+        with pytest.raises(ValueError, match="mesh-site"):
+            FaultPlan.from_spec("straggler@j1")
+        with pytest.raises(ValueError, match="device-site"):
+            FaultPlan.from_spec("oom@d1")
+        with pytest.raises(ValueError, match="job-site"):
+            FaultPlan.from_spec("worker@d1")
+
+    @pytest.mark.parametrize("kind", MESH_KINDS)
+    def test_each_kind_fires_with_shard(self, kind):
+        """Falsifiability per kind: the rule fires at its (shard,
+        iteration) site, raises ITS class, and the exception carries the
+        implicated shard — the attribution the mesh ladder and the
+        mesh_faults counter run on."""
+        p = FaultPlan.from_spec(f"{kind}@d2.p1x1")
+        p.check_mesh(1, 1)               # other shard: silent
+        p.check_mesh(2, 2)               # other iteration: silent
+        with pytest.raises(MESH_EXC[kind]) as ei:
+            p.check_mesh(2, 1)
+        assert ei.value.shard == 2
+        assert ei.value.kind == kind
+        p.check_mesh(2, 1)               # count exhausted: silent
+
+    def test_mesh_rules_never_fire_at_device_or_job_sites(self):
+        p = FaultPlan.from_spec("device_lost@d0")
+        p.check(0)                       # bucket site
+        p.check(0, 1)                    # pass site
+        assert not p.fires_job(0, "worker")
+
+    def test_make_fault_mesh_kinds(self):
+        for kind in MESH_KINDS:
+            e = make_fault(kind, "x", shard=3)
+            assert isinstance(e, MESH_EXC[kind]) and e.shard == 3
+
+
+class TestMeshClassify:
+    def test_injected_mesh_kinds_keep_their_label(self):
+        from proovread_tpu.pipeline.resilience import classify_fault
+        for kind in MESH_KINDS:
+            assert classify_fault(make_fault(kind, "x", shard=1)) == kind
+
+    def test_classify_mesh_fault_attribution(self):
+        from proovread_tpu.pipeline.resilience import classify_mesh_fault
+        for kind in MESH_KINDS:
+            assert classify_mesh_fault(make_fault(kind, "x", shard=2)) \
+                == (kind, 2)
+        # a REAL straggler deadline names no shard -> single-device
+        assert classify_mesh_fault(ShardStraggler()) == ("straggler", None)
+        assert classify_mesh_fault(
+            RuntimeError("device lost: chip 3 unreachable")) \
+            == ("device_lost", None)
+        assert classify_mesh_fault(
+            RuntimeError("collective all-reduce timed out")) \
+            == ("collective_timeout", None)
+        assert classify_mesh_fault(RuntimeError("plain boom")) is None
+        assert classify_mesh_fault(ValueError("device lost")) is None
+
+    def test_straggler_is_still_a_timeout_for_the_bucket_ladder(self):
+        from proovread_tpu.pipeline.resilience import classify_fault
+        assert classify_fault(ShardStraggler()) == "timeout"
+
+    def test_cap_overflow_retreats_not_shrinks(self):
+        """A bound per-shard candidate cap is a mesh fault outside the
+        shrinkable set: the bucket must retreat to the single-device
+        rung (dynamic chunks never truncate) — that retreat is what
+        makes mesh-shape invariance unconditional and lets the mesh
+        knobs stay out of the checkpoint fingerprint."""
+        from proovread_tpu.pipeline.resilience import (classify_fault,
+                                                       classify_mesh_fault)
+        from proovread_tpu.testing.faults import MeshCapExceeded
+        e = MeshCapExceeded("pass would drop 7 candidates")
+        assert classify_mesh_fault(e) == ("cap_overflow", None)
+        assert classify_fault(e) == "cap_overflow"
+        assert "cap_overflow" not in ("device_lost", "straggler")
+
+
+# --------------------------------------------------------------------------
+# unit: candidate-balanced placement
+# --------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_is_a_permutation_with_equal_shards(self):
+        rng = np.random.default_rng(0)
+        lens = rng.integers(100, 30000, 24)
+        order = balance_placement(lens, 4)
+        assert sorted(order) == list(range(24))
+        shard = shard_of_rows(order, 4)
+        assert [int((shard == k).sum()) for k in range(4)] == [6] * 4
+
+    def test_balances_length_sorted_bucket(self):
+        # buckets arrive length-grouped (_bucket_records), so the naive
+        # contiguous B/n split stacks every long read on one shard; LPT
+        # interleaves them and halves the hot-shard load
+        lens = np.array([1000] * 4 + [8000] * 4)
+        order = balance_placement(lens, 2)
+        shard = shard_of_rows(order, 2)
+        loads = [int(lens[shard == k].sum()) for k in range(2)]
+        naive = [int(lens[:4].sum()), int(lens[4:].sum())]
+        assert max(loads) == min(loads) == 18000
+        assert max(loads) < max(naive)
+
+    def test_deterministic(self):
+        lens = np.arange(16)[::-1]
+        a = balance_placement(lens, 4)
+        b = balance_placement(lens, 4)
+        np.testing.assert_array_equal(a, b)
+
+    def test_indivisible_rows_rejected(self):
+        with pytest.raises(ValueError, match="do not split"):
+            balance_placement(np.ones(10), 3)
+
+    def test_moved_reads_counts_the_rebalance(self):
+        lens = np.array([400] * 12)
+        prev = shard_of_rows(balance_placement(lens, 4), 4)
+        cur = shard_of_rows(balance_placement(lens, 3), 3)
+        moved = moved_reads(prev, cur, 12)
+        assert moved > 0                      # a shrink moves someone
+        assert moved_reads(None, cur, 12) == 0
+        assert moved_reads(prev, prev, 12) == 0
+
+
+# --------------------------------------------------------------------------
+# unit: mesh knobs never invalidate the journal (mesh-shape-invariant
+# resume), and the mesh rungs slot above the existing ladder
+# --------------------------------------------------------------------------
+
+def test_fingerprint_ignores_mesh_knobs():
+    from proovread_tpu.pipeline.driver import PipelineConfig
+    from proovread_tpu.pipeline.resilience import run_fingerprint
+    ids = ["r1", "r2"]
+    fp = [run_fingerprint(PipelineConfig(**kw), ids, 9) for kw in (
+        {}, {"mesh_shards": 4}, {"mesh_shards": 2},
+        {"mesh_shards": 4, "mesh_chunks_per_shard": 1,
+         "mesh_pass_timeout": 30.0})]
+    assert len(set(fp)) == 1
+    # a knob that DOES change output still invalidates
+    assert run_fingerprint(PipelineConfig(device_chunk=256), ids, 9) \
+        != fp[0]
+
+
+def test_mesh_level_tops_the_ladder():
+    from proovread_tpu.pipeline.resilience import LADDER, mesh_level
+    lv = mesh_level(4)
+    assert lv.name == "mesh-dp4" and lv.mesh == 4
+    assert not lv.fused and not lv.host
+    assert all(l.mesh == 0 for l in LADDER)
+
+
+# --------------------------------------------------------------------------
+# unit: mesh_* metrics schema — strict + drift-guarded like QC
+# --------------------------------------------------------------------------
+
+class TestMeshMetricsSchema:
+    def _declared(self):
+        from proovread_tpu.obs import metrics as obs_metrics
+        from proovread_tpu.pipeline.driver import _declare_metrics
+        reg = obs_metrics.MetricsRegistry()
+        _declare_metrics(reg)
+        return reg
+
+    def test_schema_never_drifts(self):
+        """The driver's declared mesh_* catalog and the independent
+        obs/validate.py declaration must match EXACTLY — the same
+        two-sided guard the QC schema has."""
+        d = self._declared().as_dict()
+        assert tuple(n for n in d["counters"]
+                     if n.startswith("mesh_")) == MESH_COUNTERS
+        assert tuple(n for n in d["gauges"]
+                     if n.startswith("mesh_")) == MESH_GAUGES
+        assert not [n for n in d["histograms"] if n.startswith("mesh_")]
+
+    def test_validate_accepts_a_real_dump(self):
+        reg = self._declared()
+        reg.counter("mesh_passes").inc(3)
+        reg.counter("mesh_faults").inc(1, kind="device_lost", shard="1")
+        reg.counter("mesh_demotions").inc(1, to_rung="mesh-dp3")
+        reg.gauge("mesh_shards_active").set(3)
+        stats = validate_mesh_metrics(reg.as_dict())
+        assert stats == {"mesh_passes": 3, "mesh_faults": 1}
+
+    def test_validate_rejects_drift(self):
+        reg = self._declared()
+        reg.counter("mesh_bogus").inc()
+        with pytest.raises(ValidationError, match="undeclared"):
+            validate_mesh_metrics(reg.as_dict())
+
+    def test_validate_rejects_unattributed_fault_series(self):
+        reg = self._declared()
+        reg.counter("mesh_faults").inc(1, kind="device_lost")  # no shard
+        with pytest.raises(ValidationError, match="shard"):
+            validate_mesh_metrics(reg.as_dict())
+
+    def test_validate_rejects_missing_declared(self):
+        d = self._declared().as_dict()
+        del d["counters"]["mesh_faults"]
+        with pytest.raises(ValidationError, match="absent"):
+            validate_mesh_metrics(d)
+
+
+# --------------------------------------------------------------------------
+# unit: the compile chokepoint picks jit vs shard_map by plan
+# --------------------------------------------------------------------------
+
+class TestCompileChokepoint:
+    def test_no_mesh_is_plain_jit(self):
+        import jax.numpy as jnp
+        from proovread_tpu.parallel.dmesh import compile_step_with_plan
+        f = compile_step_with_plan(lambda x: x + 1)
+        assert int(f(jnp.asarray(41))) == 42
+
+    def test_mesh_routes_through_shard_map(self):
+        import jax
+        import jax.numpy as jnp
+        from proovread_tpu.parallel.compat import PartitionSpec as P
+        from proovread_tpu.parallel.dmesh import (compile_step_with_plan,
+                                                  make_dp_mesh)
+        n = min(4, jax.device_count())
+        mesh = make_dp_mesh(n)
+
+        def body(x):
+            return jax.lax.psum(x.sum(), "dp")
+
+        f = compile_step_with_plan(body, mesh, in_specs=(P("dp"),),
+                                   out_specs=P())
+        out = f(jnp.arange(4 * n, dtype=jnp.int32))
+        assert int(out) == sum(range(4 * n))
+
+
+# --------------------------------------------------------------------------
+# e2e: mesh-shape invariance, chip-loss recovery, cross-shape resume.
+# One baseline per module; every run must reproduce its QC artifact
+# byte-for-byte (the PR-5 per-read-record parity machinery).
+# --------------------------------------------------------------------------
+
+def _qc_run(longs, srs, **kw):
+    from proovread_tpu.pipeline import Pipeline, PipelineConfig, TrimParams
+    cfg = dict(mode="sr", n_iterations=2, sampling=False,
+               device_chunk=128, batch_reads=8, host_chunk_rows=512,
+               mesh_chunks_per_shard=1, trim=TrimParams(min_length=150))
+    cfg.update(kw)
+    with obs_qc.scope() as rec:
+        res = Pipeline(PipelineConfig(**cfg)).run(longs, srs)
+        agg = json.dumps(rec.aggregate(), sort_keys=True)
+        recs = {r["id"]: r for r in rec.iter_records()}
+    return agg, recs, res
+
+
+def _assert_identical(base, other, what):
+    agg_a, recs_a = base[0], base[1]
+    agg_b, recs_b = other[0], other[1]
+    assert set(recs_a) == set(recs_b), what
+    for rid in recs_a:
+        for k in recs_a[rid]:
+            assert recs_a[rid][k] == recs_b[rid][k], (
+                f"{what}: read {rid} field {k}: "
+                f"{recs_a[rid][k]!r} != {recs_b[rid][k]!r}")
+    assert agg_a == agg_b, f"{what}: aggregate differs"
+
+
+@pytest.fixture(scope="module")
+def mesh_workload():
+    from proovread_tpu.io.simulate import simulate_independent_segments
+    longs, srs = simulate_independent_segments(seed=11, n_long=12,
+                                               read_len=300, sr_per=6)
+    base = _qc_run(longs, srs)
+    return longs, srs, base
+
+
+@pytest.mark.heavy
+class TestMeshShapeInvariance:
+    def test_mesh_2_and_4_match_single_device(self, mesh_workload):
+        """Same workload on 1 / 2 / 4 simulated devices: byte-identical
+        per-read QC records and aggregate (hence identical corrected
+        output — the records embed out_len/edits/uplift per read)."""
+        longs, srs, base = mesh_workload
+        for n in (2, 4):
+            if jax.device_count() < n:
+                pytest.skip(f"needs >= {n} devices")
+            out = _qc_run(longs, srs, mesh_shards=n)
+            _assert_identical(base, out, f"mesh={n} vs single-device")
+
+    def test_device_lost_completes_via_shrunken_mesh(self, mesh_workload):
+        """The headline: shard 1 dies at iteration 2 of a 4-way mesh ->
+        the bucket re-enters the mesh rung at mesh-dp3 with shard 1's
+        reads rebalanced onto the survivors, completes, and the output
+        is byte-identical to the unfaulted single-device run. The fault
+        and the demotion are attributed (shard, kind, destination)."""
+        longs, srs, base = mesh_workload
+        if jax.device_count() < 4:
+            pytest.skip("needs >= 4 devices")
+        out = _qc_run(longs, srs, mesh_shards=4,
+                      fault_spec="device_lost@d1.p2")
+        res = out[2]
+        demotes = [r.note for r in res.reports
+                   if r.task.startswith("demote")]
+        assert any("shard 1" in n and "'mesh-dp3'" in n for n in demotes)
+        _assert_identical(base, out, "device_lost@d1 shrunken mesh")
+        validate_mesh_metrics(res.metrics)
+        faults = {tuple(sorted(s["labels"].items())): s["value"]
+                  for s in res.metrics["counters"]["mesh_faults"]["series"]}
+        assert faults[(("kind", "device_lost"), ("shard", "1"))] >= 1
+        rb = res.metrics["gauges"]["mesh_rebalanced_reads"]["series"]
+        assert rb and rb[0]["value"] > 0
+
+    def test_resume_mesh4_journal_at_mesh2(self, mesh_workload, tmp_path):
+        """A journal written at mesh=4 resumes at mesh=2: the replayed
+        bucket splices byte-identically (entries are keyed by read
+        content, not shard slot) and the recomputed bucket matches too."""
+        import glob
+        import os
+        longs, srs, base = mesh_workload
+        if jax.device_count() < 4:
+            pytest.skip("needs >= 4 devices")
+        ck = str(tmp_path / "ckpt")
+        _qc_run(longs, srs, mesh_shards=4, checkpoint_dir=ck)
+        ents = sorted(glob.glob(os.path.join(ck, "bucket_*.json")))
+        assert len(ents) == 2
+        os.unlink(ents[-1])       # deterministic "killed mid-run"
+        out = _qc_run(longs, srs, mesh_shards=2, checkpoint_dir=ck,
+                      resume=True)
+        replays = sum(
+            s["value"] for s in out[2].metrics["counters"]
+            ["checkpoint_journal_replays"]["series"])
+        assert replays == 1
+        _assert_identical(base, out, "mesh=4 journal -> mesh=2 resume")
