@@ -8,9 +8,12 @@
 #   make qc-smoke     end-to-end --qc-out + per-read QC schema validation
 #   make perf-check   perf-regression gate over the BENCH_*.json history
 #   make perf-report  PERF.md-style phase/kernel tables from that history
+#   make prewarm      populate the persistent compile cache (cold+warm runs)
+#                     and record a COMPILE_*.json census row per config
+#   make compile-check  cold-start regression gate over COMPILE_*.json
 #   make bench        the benchmark itself (one JSON row on stdout)
 
-.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke perf-check perf-report bench
+.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke perf-check perf-report prewarm compile-check bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -32,12 +35,14 @@ test-all:
 test-faults:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults
 
-# observability tier: a full CLI run with --trace/--metrics-out/--qc-out,
-# then schema-validation of all three artifacts (root span >=95% covered,
+# observability tier: a full CLI run with --trace/--metrics-out/--qc-out/
+# --compile-ledger, then schema-validation of all four artifacts (root
+# span >=95% covered,
 # bucket spans carry the compile/execute split AND the PR-4 cost/memory
 # attribution — flops, bytes accessed, peak bytes, live bytes — the
 # per-read QC JSONL validates strictly with records linked to bucket span
-# ids, plus the end-of-run live-array leak check) — docs/OBSERVABILITY.md.
+# ids, the compile-ledger rows reconcile with the span tree's compile
+# split, plus the end-of-run live-array leak check) — docs/OBSERVABILITY.md.
 # Uses the F.antasticus sample when present, else a synthetic workload;
 # runs on CPU.
 trace-smoke:
@@ -76,6 +81,29 @@ dmesh-smoke:
 # Exits 1 and prints PERF-REGRESSION lines on any breached threshold.
 perf-check:
 	python -m proovread_tpu.obs.regress check
+
+# compile-cache prewarm (docs/OBSERVABILITY.md "Compile ledger & census"):
+# cold + warm CLI runs per config through a pinned cache dir — the cold
+# run measures the true compile wall and populates the cache (the
+# shippable warm-start artifact, ROADMAP item 3), the warm run must show
+# a persistent-cache hit rate >= 0.90 or the target fails. Config 3 runs
+# under its pinned --cap-bases sample (census.DEFAULT_CAPS) so the CPU
+# row stays minutes, not hours; rows append to $(COMPILE_OUT).
+# Usage: make prewarm [CONFIGS=4,3] [COMPILE_OUT=COMPILE_r10.json]
+CONFIGS ?= 4
+COMPILE_OUT ?= COMPILE_prewarm.json
+prewarm:
+	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.census prewarm \
+		--configs $(CONFIGS) --fresh --cache-dir .jax_cache_prewarm \
+		--out $(COMPILE_OUT)
+
+# cold-start regression gate: every (config, backend) pool's newest
+# COMPILE_*.json row vs its rolling baseline — warm compile seconds,
+# distinct-program count, cache hit rate. Exits 1 and prints
+# COMPILE-REGRESSION lines on any breach; item-3 refactor PRs must show
+# this green (PERF.md).
+compile-check:
+	python -m proovread_tpu.obs.census check
 
 # PERF.md-style trajectory / phase / kernel-attribution tables, generated
 # from the same history instead of hand-assembled op traces

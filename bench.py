@@ -193,34 +193,22 @@ def _bsw_microbench(R=2048, m=112, S=2048, B=4, Lp=4096, seed=0):
 _ATTRIB = {}
 
 
-class _JaxLogFilter:
-    """Keep bench stderr tails readable: jax._src.* logs one WARNING line
-    per compile STEP (trace, MLIR conversion, backend compile, cache
-    probe — BENCH_r05's tail is 100% this spam). With jax_log_compiles on
-    we still want the ONE line naming each compiled program (that is how
-    a tunneled compile-helper death is attributed), so only 'Compiling'
-    records and real errors pass."""
-
-    def filter(self, record):
-        if record.name.startswith("jax"):
-            import logging
-            return (record.levelno >= logging.ERROR
-                    or record.getMessage().startswith("Compiling "))
-        return True
-
-
-def _quiet_jax_logs():
-    import logging
-    flt = _JaxLogFilter()
-    for h in logging.getLogger().handlers:
-        h.addFilter(flt)
-    # jax's own logging config may attach handlers below the root; filter
-    # those too so the spam doesn't bypass the root handler
-    for name in list(logging.Logger.manager.loggerDict):
-        if name.startswith("jax"):
-            lg = logging.getLogger(name)
-            for h in lg.handlers:
-                h.addFilter(flt)
+def _ledger_snapshot(led) -> None:
+    """Fold the compile ledger's census into _ATTRIB so even a timeout
+    row carries the compile accounting measured so far. Replaces the old
+    jax_log_compiles stderr scrape: the ledger logs one line per fresh
+    program (compile-death attribution) and the census supplies the
+    compile_s / n_programs / cache_hit_rate row fields."""
+    c = led.census()
+    _ATTRIB["compile_s"] = c["backend_compile_s"]
+    _ATTRIB["n_compiles"] = c["backend_compiles"]
+    _ATTRIB["n_programs"] = c["n_programs"]
+    _ATTRIB["cache_hit_rate"] = c["persistent_hit_rate"]
+    _ATTRIB["compile_census"] = {k: c[k] for k in
+                                 ("n_entries", "calls", "tracing_hits",
+                                  "tracing_misses", "tracing_hit_rate",
+                                  "persistent_hits", "persistent_misses",
+                                  "top")}
 
 
 def _retry(fn, what, tries=4):
@@ -250,11 +238,21 @@ def _retry(fn, what, tries=4):
 
 
 def _bench_config(config: int, timed_runs: int = 3) -> dict:
+    from proovread_tpu import obs
     from proovread_tpu.ops.encode import encode_ascii
     from proovread_tpu.pipeline import Pipeline, PipelineConfig
 
     _ATTRIB.clear()     # per-config: a fallback run must not inherit the
     #                     failed config's half-collected attribution
+    # compile ledger for the WHOLE config — warm-up (where the compiles
+    # are), timed runs (a compile there is real information) and the
+    # attribution run. Ledger cost on the timed path is one signature
+    # hash per wrapped-entry call, microseconds against a multi-second
+    # run; verbose=True logs one line per fresh program, which is the
+    # compile-death attribution the old jax_log_compiles stderr scrape
+    # existed for.
+    ledger = obs.compilecache.install(
+        obs.compilecache.Ledger(verbose=True))
     _log(f"config {config}: building workload")
     if config == 1:
         longs, srs, truth, n_it = _fantasticus_workload(6)
@@ -274,6 +272,8 @@ def _bench_config(config: int, timed_runs: int = 3) -> dict:
 
     _log("warm-up run (compiles)")
     _retry(run_once, "warm-up")
+    _ledger_snapshot(ledger)    # a later timeout row still carries the
+    #                             warm-up's compile accounting
     times = []
     res = None
     for k in range(timed_runs):
@@ -303,10 +303,9 @@ def _bench_config(config: int, timed_runs: int = 3) -> dict:
         finally:
             obs.memory.uninstall()
         phases = _ATTRIB["phases"] = tr.phase_totals()
-        n_compiles = _ATTRIB["n_compiles"] = tr.n_compiles
-        compile_s = _ATTRIB["compile_s"] = round(tr.compile_s, 3)
         kernels = _ATTRIB["kernels"] = prof.as_dict()
         peak_live = _ATTRIB["peak_live_bytes"] = mem.peak_live
+        _ledger_snapshot(ledger)
     except Exception as e:                                  # noqa: BLE001
         # the run-level --wall-budget deadline must keep propagating to
         # main()'s partial-row handler — only attribution-local failures
@@ -316,15 +315,15 @@ def _bench_config(config: int, timed_runs: int = 3) -> dict:
         # before the failure is real data
         try:
             _ATTRIB["phases"] = tr.phase_totals()
-            _ATTRIB["n_compiles"] = tr.n_compiles
-            _ATTRIB["compile_s"] = round(tr.compile_s, 3)
             _ATTRIB["kernels"] = prof.as_dict()
             _ATTRIB["peak_live_bytes"] = mem.peak_live
         except Exception:                               # noqa: BLE001
             pass
+        try:
+            _ledger_snapshot(ledger)
+        except Exception:                               # noqa: BLE001
+            pass
         phases = _ATTRIB.get("phases")
-        n_compiles = _ATTRIB.get("n_compiles")
-        compile_s = _ATTRIB.get("compile_s")
         kernels = _ATTRIB.get("kernels")
         peak_live = _ATTRIB.get("peak_live_bytes")
         if isinstance(e, WallClockExceeded):
@@ -417,10 +416,18 @@ def _bench_config(config: int, timed_runs: int = 3) -> dict:
         # meanings. "kernels" is the per-entry-point cost/memory table
         # (obs/profile.py) the perf-regression gate and `make perf-report`
         # consume; "peak_live_bytes" is the sampled live-array high-water
-        # mark of the attribution run.
+        # mark of the attribution run. Compile accounting
+        # (compile_s / n_compiles / n_programs / cache_hit_rate /
+        # compile_census) is LEDGER-driven (obs/compilecache.py) and
+        # covers the whole config — warm-up included, which is where the
+        # compiles actually are (the pre-PR-9 rows measured only the
+        # warm attribution run, i.e. ~0).
         "phases": phases,
-        "n_compiles": n_compiles,
-        "compile_s": compile_s,
+        "n_compiles": _ATTRIB.get("n_compiles"),
+        "compile_s": _ATTRIB.get("compile_s"),
+        "n_programs": _ATTRIB.get("n_programs"),
+        "cache_hit_rate": _ATTRIB.get("cache_hit_rate"),
+        "compile_census": _ATTRIB.get("compile_census"),
         "kernels": kernels,
         "peak_live_bytes": peak_live,
     }
@@ -458,21 +465,15 @@ def main():
                         format="[%(asctime)s] %(message)s",
                         datefmt="%H:%M:%S")
 
-    import jax
     # persistent compile cache: steady-state numbers, not XLA compile time
-    # (per backend — the CPU cache is the one the test suite keeps warm)
-    jax.config.update("jax_compilation_cache_dir",
-                      "/root/repo/.jax_cache_cpu"
-                      if jax.default_backend() == "cpu"
-                      else "/root/repo/.jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    # name every compile on stderr: when the tunneled compile helper dies,
-    # the log shows WHICH program killed it — but ONLY the one 'Compiling
-    # jit(name)' line per program. The rest of the jax._src WARNING
-    # firehose (tracing/MLIR/cache-probe steps, double-printed via the
-    # root handler) is what drowned BENCH_r05's timeout tail.
-    jax.config.update("jax_log_compiles", True)
-    _quiet_jax_logs()
+    # (per backend — the CPU cache is the one the test suite keeps warm).
+    # One helper (obs/compilecache.py) shared with the CLI, the server
+    # and parallel/smoke.py; compile-death attribution comes from the
+    # ledger's one-line-per-program log (replacing the jax_log_compiles
+    # stderr scrape that drowned BENCH_r05's timeout tail in the
+    # jax._src WARNING firehose).
+    from proovread_tpu.obs.compilecache import enable_persistent_cache
+    enable_persistent_cache()
 
     # internal wall budget (VERDICT top_next): the scaled regime has never
     # completed inside a recorded bench window — a run that blows the
@@ -494,6 +495,8 @@ def main():
                "wall_s": round(time.monotonic() - t_start, 2),
                "timeout_error": (str(err).splitlines() or [""])[0][:300],
                "phases": None, "n_compiles": None, "compile_s": None,
+               "n_programs": None, "cache_hit_rate": None,
+               "compile_census": None,
                "kernels": None, "peak_live_bytes": None}
         row.update(_ATTRIB)
         return row

@@ -117,6 +117,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "trajectory, support depth, corrected bases, "
                          "chimera/siamaera/trim funnel — "
                          "docs/OBSERVABILITY.md)")
+    ap.add_argument("--compile-ledger", metavar="FILE",
+                    help="write the compile ledger as JSONL — one "
+                         "strict-schema row per XLA compilation event "
+                         "(entry point, shape-signature, bucket, "
+                         "tracing/persistent cache hit-vs-miss) plus a "
+                         "program-zoo census meta line; zero device "
+                         "overhead when off (docs/OBSERVABILITY.md "
+                         "'Compile ledger & census')")
+    ap.add_argument("--compile-cache", metavar="DIR", nargs="?",
+                    const="auto",
+                    help="enable the persistent XLA compile cache at DIR "
+                         "(bare flag: the per-backend default directory "
+                         "`make prewarm` populates)")
     ap.add_argument("--xprof", metavar="DIR",
                     help="wrap the run in jax.profiler.trace(DIR) with "
                          "TraceAnnotations named after the spans, so XLA "
@@ -281,6 +294,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     trace_path = args.trace or cfg.get("trace-file")
     metrics_path = args.metrics_out or cfg.get("metrics-out")
     qc_path = args.qc_out or cfg.get("qc-out")
+    ledger_path = args.compile_ledger or cfg.get("compile-ledger")
+    cache_dir = args.compile_cache or cfg.get("compile-cache-dir")
+    if cache_dir:
+        cache_dir = obs.compilecache.enable_persistent_cache(cache_dir)
+        log.info("compile cache: persistent XLA cache at %s", cache_dir)
     tracing_on = bool(trace_path or args.xprof)
     tracer = obs.install_tracer() if tracing_on else None
     registry = obs.metrics.install() if metrics_path else None
@@ -288,6 +306,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     mem_sampler = obs.memory.install() if tracing_on else None
     leak_check = obs.memory.LeakCheck() if tracing_on else None
     qc_recorder = obs.qc.install() if qc_path else None
+    ledger = obs.compilecache.install() if ledger_path else None
     xprof_cm = None
     if args.xprof:
         # a failed profiler-session start (unwritable dir, session already
@@ -312,6 +331,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 obs.metrics.uninstall()
             if qc_recorder is not None:
                 obs.qc.uninstall()
+            if ledger is not None:
+                obs.compilecache.uninstall()
             raise
         log.info("xprof: XLA op trace -> %s (TraceAnnotations follow the "
                  "span tree)", args.xprof)
@@ -370,6 +391,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                     log.info("%s", ln)
             except OSError as e:
                 log.warning("qc write failed: %s", e)
+        if ledger is not None:
+            obs.compilecache.uninstall()
+            try:
+                # written even on a crashed run: a death mid-compile
+                # leaves the rows naming every program that DID compile
+                census = ledger.census()
+                ledger.write_jsonl(ledger_path, census=census)
+                log.info("compile ledger: %d row(s) / %d program(s) -> "
+                         "%s", len(ledger.rows), census["n_programs"],
+                         ledger_path)
+                for ln in ledger.report_lines(census=census):
+                    log.info("%s", ln)
+            except OSError as e:
+                log.warning("compile ledger write failed: %s", e)
         if registry is not None:
             obs.metrics.uninstall()
             try:

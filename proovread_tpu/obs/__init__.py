@@ -14,6 +14,12 @@
   trajectories, support depth, corrected-base/phred-uplift counts,
   chimera/siamaera/trim funnel) serialized as ``--qc-out`` JSONL plus
   an aggregate QC report.
+- ``obs.compilecache`` — the compile ledger: one strict-schema row per
+  XLA compilation event (entry point, shape-signature, bucket,
+  tracing/persistent cache hit-vs-miss) serialized as
+  ``--compile-ledger`` JSONL, summarized as a program-zoo census
+  (``obs.census``: ``make prewarm`` / ``make compile-check``), plus
+  the one persistent-compile-cache wiring helper.
 
 Both are off by default (shared no-op singletons) and are enabled by the
 CLI ``--trace`` / ``--metrics-out`` flags, the ``trace-file`` /
@@ -21,7 +27,7 @@ CLI ``--trace`` / ``--metrics-out`` flags, the ``trace-file`` /
 ``obs.tracing()`` / ``obs.metrics.scope()``. See docs/OBSERVABILITY.md.
 """
 
-from proovread_tpu.obs import memory, metrics, profile, qc
+from proovread_tpu.obs import compilecache, memory, metrics, profile, qc
 from proovread_tpu.obs.profile import profiling
 from proovread_tpu.obs.trace import (NOOP_SPAN, Span, Tracer, count_retrace,
                                      enabled, span, tracing)
@@ -30,7 +36,8 @@ from proovread_tpu.obs.trace import install as install_tracer
 from proovread_tpu.obs.trace import uninstall as uninstall_tracer
 
 __all__ = [
-    "metrics", "memory", "profile", "qc", "profiling", "span", "Span",
+    "compilecache", "metrics", "memory", "profile", "qc", "profiling",
+    "span", "Span",
     "Tracer",
     "tracing", "enabled", "count_retrace", "current_tracer",
     "install_tracer", "uninstall_tracer", "NOOP_SPAN",

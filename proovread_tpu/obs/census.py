@@ -1,0 +1,465 @@
+"""Program-zoo census tooling: ``make prewarm`` + ``make compile-check``.
+
+The compile ledger (``obs/compilecache.py``) measures the compile wall;
+this module makes the measurement *actionable* across rounds, the way
+``obs/regress.py`` does for BENCH rows:
+
+- ``prewarm`` populates the persistent compile cache for a bench config
+  by running the real CLI pipeline twice in subprocesses — once **cold**
+  (optionally into a freshly wiped cache directory) and once **warm**
+  (fresh process, warm disk cache, so the tracing cache cannot fake the
+  hit rate) — and records one ``COMPILE_r*.json`` row per config with
+  the cold/warm compile seconds, distinct-program count and
+  persistent-cache hit rate. After a prewarm, the cache directory is the
+  shippable warm-start artifact ROADMAP item 3 asks for.
+- ``check`` is the regression gate over the ``COMPILE_*.json`` history:
+  rows pool per (config, backend) exactly like ``obs/regress.py`` pools
+  BENCH rows (a CPU row never regresses against a chip row), and the
+  gate fails (exit 1, ``COMPILE-REGRESSION:`` lines) when the newest
+  row's **warm compile seconds** grow, its **distinct-program count**
+  grows, or its **warm cache hit rate** drops against the rolling
+  baseline. Item-3 refactor PRs must show this gate green (PERF.md).
+
+Config 3 executes ~100x config 4's bases; on CPU (interpret-mode Pallas)
+that is hours per run, so its prewarm rows are recorded with a pinned
+``--cap-bases`` subsample (`DEFAULT_CAPS`) — the cap is part of the row,
+and the Makefile target pins the same cap every round, so rows stay
+comparable. The program count under a cap is a *sample* of the config-3
+zoo, not the full ~3,200; what the gate defends is that the sample never
+grows.
+
+CLI::
+
+    python -m proovread_tpu.obs.census prewarm --configs 4,3 \
+        --cache-dir .jax_cache_prewarm --fresh --out COMPILE_r09.json
+    python -m proovread_tpu.obs.census check  [COMPILE_*.json ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+# one rolling-median implementation for both gates (this module's
+# docstring claims obs/regress.py's conventions — share its code too)
+from proovread_tpu.obs.regress import _median
+
+SCHEMA_VERSION = 1
+
+# warm-run compile seconds may grow by this fraction of the baseline ...
+WARM_COMPILE_THRESHOLD = 0.30
+# ... but only when the absolute growth also exceeds this (a warm run's
+# compile seconds are near zero; pure ratios on ~0 baselines cry wolf)
+WARM_COMPILE_MIN_ABS_S = 0.5
+# the distinct-program count may not grow beyond this fraction (the zoo
+# is deterministic for a pinned config; growth means a new shape variant)
+PROGRAMS_THRESHOLD = 0.02
+# the warm persistent-cache hit rate may drop by this much, absolute
+HIT_RATE_DROP = 0.05
+# rolling baseline: median over up to this many prior usable rows
+BASELINE_WINDOW = 3
+
+# pinned per-config long-read caps for CPU prewarm rows (see module doc);
+# None = the full config workload
+DEFAULT_CAPS: Dict[int, Optional[int]] = {3: 80_000, 4: None}
+# the warm run must show at least this persistent-cache hit rate, or the
+# prewarm itself failed at its one job (populating the cache)
+MIN_WARM_HIT_RATE = 0.90
+
+
+def _log(msg: str) -> None:
+    print(f"[prewarm] {msg}", file=sys.stderr, flush=True)
+
+
+# -- workloads (bench.py's config ladder, rebuilt from the simulators) -----
+
+def _build_workload(config: int, cap_bases: Optional[int]):
+    """(longs, srs) for a prewarm-able bench config — 3 and 4 only (the
+    simulated, self-contained ladder rungs; configs 1/2 differ only by
+    iteration schedule, which the CLI runner cannot express, and need
+    the reference sample). Generation parameters — genome size, total
+    bases, seeds — MUST stay in sync with bench.py's builders
+    (`_ci_scale_workload` / `_ecoli_class_workload`) so COMPILE pools
+    measure the same zoo the BENCH pools run.
+
+    A ``cap_bases`` on config 3 builds a **scaled slice**: genome of
+    ``cap/4`` bases so the 4x long-read and 30x short-read coverage
+    ratios match the full config — a read-prefix over the full genome
+    would leave the CLI's coverage estimate (total SR / total LR) ~60x
+    too high, the sampler would keep ~3% of the short reads, and
+    nothing would align (an empty-admission run compiles a different,
+    meaningless program sequence)."""
+    from proovread_tpu.io.simulate import (random_genome,
+                                           simulate_long_reads,
+                                           simulate_short_reads)
+    if config == 4:
+        genome = random_genome(10_000, seed=0)
+        longs, _ = simulate_long_reads(genome, 40_000, seed=1)
+    elif config == 3:
+        if cap_bases:
+            # scaled slice (see docstring): genome cap/4, floored so the
+            # lognormal length tail (N50 ~7 kb) is not squashed and the
+            # Lp bucket ladder stays multi-stack
+            genome = random_genome(max(cap_bases // 4, 21_000), seed=0)
+            longs, _ = simulate_long_reads(genome, cap_bases, seed=1)
+        else:
+            genome = random_genome(1_250_000, seed=0)
+            longs, _ = simulate_long_reads(genome, 5_000_000, seed=1)
+    else:
+        raise ValueError(
+            f"prewarm supports bench configs 3 and 4, not {config}")
+    return longs, simulate_short_reads(genome, 30.0, seed=2)
+
+
+def _write_fastq(path: str, records) -> None:
+    from proovread_tpu.io.fastq import FastqWriter
+    with open(path, "wb") as fh:
+        w = FastqWriter(fh)
+        for r in records:
+            w.write(r)
+
+
+def _run_cli(long_fq: str, short_fq: str, out: str, ledger: str,
+             cache_dir: str, timeout: float) -> None:
+    """One pipeline run in a FRESH subprocess (an in-process rerun would
+    hit the jit tracing cache and report a fake 100% warm rate)."""
+    cmd = [sys.executable, "-m", "proovread_tpu.cli",
+           "-l", long_fq, "-s", short_fq, "-p", out, "-m", "sr-noccs",
+           "--compile-ledger", ledger, "--compile-cache", cache_dir,
+           "--overwrite"]
+    proc = subprocess.run(cmd, env=os.environ, cwd=os.getcwd(),
+                          timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f"prewarm pipeline run exited "
+                           f"{proc.returncode}: {' '.join(cmd)}")
+
+
+def _ledger_census(path: str) -> Dict[str, Any]:
+    from proovread_tpu.obs.validate import validate_compile_ledger
+    return validate_compile_ledger(path)["census"]
+
+
+def _phase(census: Dict[str, Any], wall_s: float) -> Dict[str, Any]:
+    return {"wall_s": round(wall_s, 2),
+            "compile_s": census["backend_compile_s"],
+            "n_programs": census["n_programs"],
+            "backend_compiles": census["backend_compiles"],
+            "persistent_hit_rate": census["persistent_hit_rate"]}
+
+
+def prewarm_config(config: int, cache_dir: str, *,
+                   cap_bases: Optional[int] = None,
+                   fresh: bool = False,
+                   run_timeout: float = 5400.0) -> Dict[str, Any]:
+    """Cold + warm CLI runs for one config; returns the COMPILE row.
+
+    The parent deliberately never initializes jax: on a TPU host libtpu
+    device ownership is process-exclusive, and a parent that touched the
+    backend would starve the measured subprocess runs. The row's
+    ``backend`` comes from the cold run's ledger census instead."""
+    import shutil
+
+    if fresh and os.path.isdir(cache_dir):
+        _log(f"config {config}: wiping cache dir {cache_dir} (--fresh)")
+        shutil.rmtree(cache_dir)
+    longs, srs = _build_workload(config, cap_bases)
+    total_bases = sum(len(r) for r in longs)
+    _log(f"config {config}: {len(longs)} reads / {total_bases} bases"
+         + (f" (cap {cap_bases})" if cap_bases else ""))
+    with tempfile.TemporaryDirectory(prefix="proovread_prewarm_") as tmp:
+        lp, sp = os.path.join(tmp, "long.fq"), os.path.join(tmp, "short.fq")
+        _write_fastq(lp, longs)
+        _write_fastq(sp, srs)
+        phases = {}
+        backend = None
+        for phase in ("cold", "warm"):
+            led = os.path.join(tmp, f"{phase}.ledger.jsonl")
+            _log(f"config {config}: {phase} run")
+            t0 = time.monotonic()
+            _run_cli(lp, sp, os.path.join(tmp, f"out_{phase}"), led,
+                     cache_dir, run_timeout)
+            census = _ledger_census(led)
+            backend = census["backend"]
+            phases[phase] = _phase(census, time.monotonic() - t0)
+            _log(f"config {config}: {phase} -> "
+                 f"{json.dumps(phases[phase])}")
+    return {"metric": "compile_census", "schema": SCHEMA_VERSION,
+            "config": config, "backend": backend,
+            "cap_bases": cap_bases, "n_reads": len(longs),
+            "total_bases": total_bases, "cache_dir": cache_dir,
+            "cold": phases["cold"], "warm": phases["warm"],
+            "cache_hit_rate": phases["warm"]["persistent_hit_rate"]}
+
+
+# -- the gate ---------------------------------------------------------------
+
+def load_rows(paths: List[str]) -> List[Dict[str, Any]]:
+    """COMPILE history rows, oldest first (one JSON object or JSON-lines
+    per file, ``obs/regress.py`` conventions)."""
+    out: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as fh:
+            text = fh.read()
+        objs: List[Any] = []
+        try:
+            obj = json.loads(text)
+            objs = obj if isinstance(obj, list) else [obj]
+        except json.JSONDecodeError:
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    objs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        for obj in objs:
+            if isinstance(obj, dict) and obj.get("metric") == \
+                    "compile_census":
+                out.append({"source": path, "row": obj})
+    return out
+
+
+def _usable(entry: Dict[str, Any]) -> bool:
+    row = entry["row"]
+    return (isinstance(row.get("cold"), dict)
+            and isinstance(row.get("warm"), dict))
+
+
+def _pool_key(row: Dict[str, Any]):
+    return (int(row.get("config", 0)), row.get("backend") or "tpu")
+
+
+def compile_check(entries: List[Dict[str, Any]],
+                  warm_threshold: float = WARM_COMPILE_THRESHOLD,
+                  warm_min_abs_s: float = WARM_COMPILE_MIN_ABS_S,
+                  programs_threshold: float = PROGRAMS_THRESHOLD,
+                  hit_rate_drop: float = HIT_RATE_DROP,
+                  window: int = BASELINE_WINDOW) -> Dict[str, Any]:
+    """The gate, as data: every (config, backend) pool's newest row vs a
+    rolling baseline of its predecessors. Verdict PASS / REGRESSION /
+    NO-DATA; check statuses ok / regressed / skipped / missing."""
+    checks: List[Dict[str, Any]] = []
+    for e in entries:
+        if not _usable(e):
+            checks.append({"check": "row", "status": "missing",
+                           "source": e["source"],
+                           "note": "row lacks cold/warm phases"})
+    usable = [e for e in entries if _usable(e)]
+    if not usable:
+        return {"schema": SCHEMA_VERSION, "verdict": "NO-DATA",
+                "pools": [], "checks": checks}
+
+    pools: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in usable:
+        pools.setdefault(_pool_key(e["row"]), []).append(e)
+
+    def _grew(name, new, base, *, threshold, min_abs=0.0):
+        regressed = (new - base > min_abs
+                     and new > base * (1 + threshold))
+        return {"check": name,
+                "status": "regressed" if regressed else "ok",
+                "value": round(new, 4), "baseline": round(base, 4),
+                "threshold": threshold}
+
+    pool_names = []
+    for key in sorted(pools):
+        group = pools[key]
+        latest = group[-1]
+        base = group[:-1][-window:]
+        name = f"config{key[0]}/{key[1]}"
+        pool_names.append(name)
+        if not base:
+            checks.append({"check": f"{name}:baseline",
+                           "status": "skipped",
+                           "note": "no prior rows in this pool — "
+                                   "nothing to regress against"})
+            continue
+        lrow = latest["row"]
+        checks.append(_grew(
+            f"{name}:warm_compile_s", float(lrow["warm"]["compile_s"]),
+            _median([float(e["row"]["warm"]["compile_s"])
+                     for e in base]),
+            threshold=warm_threshold, min_abs=warm_min_abs_s))
+        checks.append(_grew(
+            f"{name}:n_programs", float(lrow["cold"]["n_programs"]),
+            _median([float(e["row"]["cold"]["n_programs"])
+                     for e in base]),
+            threshold=programs_threshold))
+        rates = [e["row"].get("cache_hit_rate") for e in base]
+        rates = [float(r) for r in rates if r is not None]
+        lrate = lrow.get("cache_hit_rate")
+        if rates and lrate is not None:
+            base_rate = _median(rates)
+            regressed = float(lrate) < base_rate - hit_rate_drop
+            checks.append({
+                "check": f"{name}:cache_hit_rate",
+                "status": "regressed" if regressed else "ok",
+                "value": round(float(lrate), 4),
+                "baseline": round(base_rate, 4),
+                "threshold": hit_rate_drop})
+        else:
+            checks.append({"check": f"{name}:cache_hit_rate",
+                           "status": "skipped",
+                           "note": "hit rate absent (cache off?)"})
+    verdict = ("REGRESSION" if any(c["status"] == "regressed"
+                                   for c in checks) else "PASS")
+    return {"schema": SCHEMA_VERSION, "verdict": verdict,
+            "pools": pool_names, "checks": checks}
+
+
+# -- CLI -------------------------------------------------------------------
+
+def _resolve_paths(args_paths: List[str]) -> List[str]:
+    if args_paths:
+        return args_paths
+    # round-numbered history first, then any non-r files (the default
+    # `make prewarm` output COMPILE_prewarm.json) LAST: the freshest
+    # local measurement must be the gate's "latest", not its baseline —
+    # a plain name sort would put COMPILE_p* before COMPILE_r* and
+    # invert the comparison for the documented prewarm->check flow
+    rounds = sorted(_glob.glob("COMPILE_r*.json"))
+    rest = sorted(p for p in _glob.glob("COMPILE_*.json")
+                  if p not in rounds)
+    return rounds + rest
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proovread-tpu-census",
+        description="Compile-cache prewarm + cold-start regression gate "
+                    "over COMPILE_*.json history (docs/OBSERVABILITY.md "
+                    "'Compile ledger & census').")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    pw = sub.add_parser("prewarm",
+                        help="populate the persistent cache (cold+warm "
+                             "runs) and record a COMPILE row per config")
+    pw.add_argument("--configs", default="4",
+                    help="comma-separated bench configs (default: 4)")
+    pw.add_argument("--cache-dir", default=None,
+                    help="persistent-cache dir to populate (default: "
+                         "the per-backend shared default)")
+    pw.add_argument("--fresh", action="store_true",
+                    help="wipe the cache dir before the FIRST config so "
+                         "its cold run measures a true cold start "
+                         "(later configs add to the same cache)")
+    pw.add_argument("--cap-bases", default=None,
+                    help="override per-config long-read caps, e.g. "
+                         "'3=80000' (default: census.DEFAULT_CAPS)")
+    pw.add_argument("--out", default=None, metavar="FILE",
+                    help="append rows to this COMPILE_*.json "
+                         "(JSON-lines); default: stdout only")
+    pw.add_argument("--run-timeout", type=float, default=5400.0)
+    pw.add_argument("--min-warm-hit-rate", type=float,
+                    default=MIN_WARM_HIT_RATE,
+                    help="fail unless every warm run's persistent-cache "
+                         f"hit rate reaches this (default "
+                         f"{MIN_WARM_HIT_RATE}; 0 disables)")
+    chk = sub.add_parser("check", help="gate: exit 1 on regression")
+    chk.add_argument("files", nargs="*",
+                     help="COMPILE history files (default: "
+                          "COMPILE_*.json)")
+    chk.add_argument("--warm-threshold", type=float,
+                     default=WARM_COMPILE_THRESHOLD)
+    chk.add_argument("--warm-min-abs-s", type=float,
+                     default=WARM_COMPILE_MIN_ABS_S)
+    chk.add_argument("--programs-threshold", type=float,
+                     default=PROGRAMS_THRESHOLD)
+    chk.add_argument("--hit-rate-drop", type=float,
+                     default=HIT_RATE_DROP)
+    chk.add_argument("--window", type=int, default=BASELINE_WINDOW)
+    args = ap.parse_args(argv)
+
+    if args.cmd == "prewarm":
+        from proovread_tpu.obs.compilecache import default_cache_dir
+        caps = dict(DEFAULT_CAPS)
+        if args.cap_bases:
+            for part in args.cap_bases.split(","):
+                k, _, v = part.partition("=")
+                caps[int(k)] = int(v) if v else None
+        # resolve the default cache dir WITHOUT initializing jax in this
+        # parent (TPU ownership is process-exclusive — see
+        # prewarm_config): the JAX_PLATFORMS env the subprocesses will
+        # inherit names the backend; unset means pass --cache-dir
+        # explicitly on multi-backend hosts
+        env_backend = ((os.environ.get("JAX_PLATFORMS") or "")
+                       .split(",")[0].strip() or "cpu")
+        cache_dir = args.cache_dir or default_cache_dir(env_backend)
+        if args.fresh and not args.cache_dir:
+            # the per-backend default is the SHARED cache the test suite
+            # and bench keep warm — wiping it silently would push the
+            # next tier-1 run past its budget with cold compiles. A
+            # fresh cold-start measurement must name its own directory
+            # (the Makefile target pins .jax_cache_prewarm).
+            print("prewarm: refusing --fresh against the shared default "
+                  f"cache {cache_dir}; pass --cache-dir explicitly "
+                  "(e.g. .jax_cache_prewarm)", file=sys.stderr)
+            return 2
+        rc = 0
+        good_rows = []
+        for i, cfg in enumerate(int(c) for c in args.configs.split(",")
+                                if c):
+            # --fresh wipes ONCE, before the first config: later configs
+            # must add their programs to the same shippable cache, not
+            # erase the previous config's
+            row = prewarm_config(cfg, cache_dir,
+                                 cap_bases=caps.get(cfg),
+                                 fresh=args.fresh and i == 0,
+                                 run_timeout=args.run_timeout)
+            print(json.dumps(row))
+            rate = row["cache_hit_rate"]
+            if args.min_warm_hit_rate and (
+                    rate is None or rate < args.min_warm_hit_rate):
+                # the broken row is printed above for diagnosis but NOT
+                # appended: a known-bad measurement entering the rolling
+                # baseline would desensitize every later compile-check
+                _log(f"FAILED: config {cfg} warm persistent-cache hit "
+                     f"rate {rate} < {args.min_warm_hit_rate} — the "
+                     "prewarm did not actually warm the cache; row "
+                     "withheld from the history")
+                rc = 1
+                continue
+            good_rows.append(row)
+        if args.out and good_rows:
+            with open(args.out, "a") as fh:
+                for row in good_rows:
+                    fh.write(json.dumps(row) + "\n")
+            _log(f"{len(good_rows)} row(s) appended to {args.out}")
+        return rc
+
+    paths = _resolve_paths(args.files)
+    if not paths:
+        print("compile-check: no COMPILE history files found",
+              file=sys.stderr)
+        return 0
+    verdict = compile_check(load_rows(paths),
+                            warm_threshold=args.warm_threshold,
+                            warm_min_abs_s=args.warm_min_abs_s,
+                            programs_threshold=args.programs_threshold,
+                            hit_rate_drop=args.hit_rate_drop,
+                            window=args.window)
+    for c in verdict["checks"]:
+        if c["status"] == "regressed":
+            print(f"COMPILE-REGRESSION: {c['check']} = {c['value']} vs "
+                  f"baseline {c['baseline']} (threshold "
+                  f"{c['threshold']})", file=sys.stderr)
+        elif c["status"] == "missing":
+            print(f"compile-check: missing — {c.get('note', c)}",
+                  file=sys.stderr)
+    print(json.dumps(verdict, sort_keys=True))
+    if verdict["verdict"] == "REGRESSION":
+        return 1
+    print(f"compile-check: {verdict['verdict']} "
+          f"({len(verdict['pools'])} pool(s))", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

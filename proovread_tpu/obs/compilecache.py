@@ -1,0 +1,469 @@
+"""Compile ledger: one strict-schema row per XLA compilation event.
+
+Config 3 cold spends >14 minutes compiling ~3,200 XLA programs across 6
+length-bucket stacks (VERDICT.md r5) — and until this module, none of
+that was attributable: the span tree says *when* compile seconds were
+spent, not *which program* spent them, and the persistent compile cache
+was ad-hoc per-tool plumbing nobody could tell was actually hitting.
+This module is the measurement side of ROADMAP item 3 ("tear down the
+compile wall"): it records every compilation event against the entry
+point and abstract shape signature that caused it, so the program zoo
+becomes a census instead of a rumor.
+
+**Event sources** (all three share one recorder, the :class:`Ledger`):
+
+- the process-wide ``jax.monitoring`` listener in ``obs/trace.py``
+  forwards every ``backend_compile_duration`` event (gated by the same
+  ``suspended_compile_attribution`` scope, so the profiler's own
+  attribution compiles never pollute the ledger);
+- the ``@attributed`` wrappers on every jitted/Pallas entry point
+  (``obs/profile.py`` — the same set the cost profiler enumerates, plus
+  the ``dmesh.compile_step_with_plan`` chokepoint) report each call's
+  entry name and abstracted shape/dtype signature, so compile events are
+  attributed to the program that triggered them and tracing-cache
+  hits/misses are counted per entry;
+- a second ``jax.monitoring`` event listener (registered here, once per
+  process) watches the persistent-cache counters
+  (``/jax/compilation_cache/compile_requests_use_cache`` /
+  ``cache_hits``), which fire *inside* the backend-compile window — so
+  every backend-compile row knows whether it was served from the
+  persistent cache ("hit"), compiled for real ("miss"), or ran with the
+  cache disabled (``null``).
+
+**Row schema** is declared independently in
+``obs/validate.py:LEDGER_ROW_FIELDS`` (strict: undeclared fields fail;
+``tests/test_compilecache.py`` lint-guards the writer against it,
+QC-style). Two row kinds:
+
+- ``retrace``: a wrapped entry point was called at a signature its jit
+  cache had not seen — the Python-level tracing-cache miss.
+  ``wall_ms`` is the full first-call window, ``compile_ms`` the backend
+  compile seconds observed inside it.
+- ``backend_compile``: one XLA backend-compile event
+  (``wall_ms == compile_ms == the event duration``). Summing these
+  reconciles with the ``--trace`` span tree's compile split — both are
+  fed by the same monitoring event.
+
+**Zero overhead off**: with no ledger installed the ``@attributed``
+wrapper costs one module-global read (guarded by
+``tests/test_compilecache.py::test_compile_ledger_zero_overhead_when_off``).
+
+**Persistent-cache wiring** (:func:`enable_persistent_cache`): the one
+helper behind ``bench.py``, ``parallel/smoke.py``, the batch CLI
+(``--compile-cache`` / config ``compile-cache-dir``) and the server —
+same per-backend default directories the tools always used
+(``<repo>/.jax_cache_cpu`` on CPU, ``.jax_cache`` otherwise), with
+``jax_persistent_cache_min_compile_time_secs=0`` so every program lands
+in the cache.
+
+See ``obs/census.py`` for the program-zoo census report, the
+``make prewarm`` cache-population tool and the ``make compile-check``
+regression gate over ``COMPILE_*.json`` history.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+from proovread_tpu.obs import trace as obs_trace
+
+log = logging.getLogger("proovread_tpu")
+
+LEDGER_SCHEMA_VERSION = 1
+
+_CACHE_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+_UNATTRIBUTED = "(unattributed)"
+
+
+def signature(args: tuple, kwargs: dict) -> str:
+    """Abstract shape/dtype signature hash of a call: array leaves
+    collapse to ``ShapeDtypeStruct``; static leaves (params dataclasses,
+    python scalars) keep their repr — both change the compiled program,
+    so both are part of the program's identity."""
+    import jax
+
+    def _spec(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    key = repr(jax.tree_util.tree_map(_spec, (args, kwargs)))
+    return hashlib.blake2b(key.encode(), digest_size=6).hexdigest()
+
+
+class Ledger:
+    """Process-wide compile-event recorder for one run/service lifetime.
+
+    Thread-safe (the serving worker compiles off the protocol threads).
+    ``verbose=True`` logs one line per fresh program *before* tracing it
+    — the compile-death attribution line that used to come from the
+    ``jax_log_compiles`` stderr scrape bench.py had to filter."""
+
+    def __init__(self, backend: Optional[str] = None,
+                 verbose: bool = False):
+        self._lock = threading.Lock()
+        self.rows: List[Dict[str, Any]] = []
+        # (entry, sig) -> call count; len() is the distinct-program count
+        self.programs: Dict[Tuple[str, str], int] = {}
+        # (entry, sig) -> backend-compile ms (the per-program offender
+        # accounting the census top-N is built from)
+        self._program_compile_ms: Dict[Tuple[str, str], float] = {}
+        self.calls = 0              # wrapped-entry calls observed
+        self.tracing_hits = 0       # calls served by the in-process cache
+        self.backend_compiles = 0
+        self.backend_compile_s = 0.0
+        self.persistent_hits = 0
+        self.persistent_misses = 0
+        self._live: List[Dict[str, Any]] = []   # in-flight first calls
+        self._pend_requests = 0     # cache events since last compile row
+        self._pend_hits = 0
+        self._backend = backend
+        self._bucket: Optional[int] = None
+        self.verbose = verbose
+
+    # -- context -----------------------------------------------------------
+    def backend(self) -> str:
+        if self._backend is None:
+            try:
+                import jax
+                self._backend = jax.default_backend()
+            except Exception:                           # noqa: BLE001
+                self._backend = "unknown"
+        return self._backend
+
+    def set_bucket(self, bucket: Optional[int]) -> None:
+        self._bucket = bucket
+
+    # -- wrapped-entry call windows (obs/profile.py attributed) ------------
+    def call_begin(self, entry: str, sig: str) -> Optional[Dict[str, Any]]:
+        """Start of a wrapped-entry call. Returns a token for
+        :meth:`call_end` when this (entry, signature) is fresh — a
+        tracing-cache miss that will emit a ``retrace`` row — else
+        ``None`` (a hit; only counted)."""
+        with self._lock:
+            self.calls += 1
+            key = (entry, sig)
+            n = self.programs.get(key)
+            if n is not None:
+                self.programs[key] = n + 1
+                self.tracing_hits += 1
+                return None
+            self.programs[key] = 1
+            tok = {"entry": entry, "sig": sig, "bucket": self._bucket,
+                   "t0": time.monotonic(),
+                   "compile_s0": self.backend_compile_s,
+                   "phits0": self.persistent_hits,
+                   "pmiss0": self.persistent_misses}
+            self._live.append(tok)
+        if self.verbose:
+            # BEFORE the trace: when a compile helper dies mid-program,
+            # this line says which program killed it (the role of the
+            # old 'Compiling jit(name)' stderr lines, minus the firehose)
+            log.info("compile-ledger: tracing %s sig=%s (program %d)",
+                     entry, sig, len(self.programs))
+        return tok
+
+    def call_end(self, tok: Optional[Dict[str, Any]]) -> None:
+        if tok is None:
+            return
+        with self._lock:
+            if tok in self._live:
+                self._live.remove(tok)
+            compile_ms = (self.backend_compile_s
+                          - tok["compile_s0"]) * 1e3
+            hits = self.persistent_hits - tok["phits0"]
+            misses = self.persistent_misses - tok["pmiss0"]
+            persistent = (None if not (hits or misses)
+                          else "miss" if misses else "hit")
+            self._row(entry=tok["entry"], sig=tok["sig"],
+                      bucket=tok["bucket"], kind="retrace",
+                      wall_ms=(time.monotonic() - tok["t0"]) * 1e3,
+                      compile_ms=compile_ms, persistent_cache=persistent)
+
+    # -- monitoring feeds (obs/trace.py hook + the cache-event hook) -------
+    def _on_backend_compile(self, duration: float) -> None:
+        with self._lock:
+            self.backend_compiles += 1
+            self.backend_compile_s += duration
+            used_cache = self._pend_requests > 0
+            hit = self._pend_hits > 0
+            self._pend_requests = 0
+            self._pend_hits = 0
+            if used_cache:
+                if hit:
+                    self.persistent_hits += 1
+                else:
+                    self.persistent_misses += 1
+            persistent = ("hit" if hit else
+                          "miss" if used_cache else None)
+            if self._live:
+                entry, sig = self._live[-1]["entry"], self._live[-1]["sig"]
+                bucket = self._live[-1]["bucket"]
+            else:
+                entry, sig, bucket = _UNATTRIBUTED, "-", self._bucket
+            ms = duration * 1e3
+            key = (entry, sig)
+            self._program_compile_ms[key] = \
+                self._program_compile_ms.get(key, 0.0) + ms
+            self._row(entry=entry, sig=sig, bucket=bucket,
+                      kind="backend_compile", wall_ms=ms, compile_ms=ms,
+                      persistent_cache=persistent)
+
+    def _on_cache_event(self, event: str) -> None:
+        with self._lock:
+            if event == _CACHE_REQUEST_EVENT:
+                self._pend_requests += 1
+            elif event == _CACHE_HIT_EVENT:
+                self._pend_hits += 1
+
+    def _row(self, **kw) -> None:
+        # field set lint-guarded against validate.py:LEDGER_ROW_FIELDS
+        # (tests/test_compilecache.py — the writer can never drift)
+        kw["backend"] = self.backend()
+        kw["wall_ms"] = round(kw["wall_ms"], 3)
+        kw["compile_ms"] = round(kw["compile_ms"], 3)
+        self.rows.append(kw)
+
+    # -- census ------------------------------------------------------------
+    def census(self) -> Dict[str, Any]:
+        """Program-zoo census: distinct programs per entry point, cache
+        hit rates, top-N compile-time offenders. Embedded in
+        ``PipelineResult.compile_census``, the ledger artifact's meta
+        line, bench rows and the serving SLO artifact."""
+        with self._lock:
+            by_entry: Dict[str, Dict[str, Any]] = {}
+            for (entry, _sig), n in self.programs.items():
+                e = by_entry.setdefault(
+                    entry, {"programs": 0, "calls": 0, "compile_ms": 0.0})
+                e["programs"] += 1
+                e["calls"] += n
+            for (entry, _sig), ms in self._program_compile_ms.items():
+                e = by_entry.setdefault(
+                    entry, {"programs": 0, "calls": 0, "compile_ms": 0.0})
+                e["compile_ms"] = round(e["compile_ms"] + ms, 3)
+            top = sorted(self._program_compile_ms.items(),
+                         key=lambda kv: -kv[1])[:10]
+            misses = self.calls - self.tracing_hits
+            p_total = self.persistent_hits + self.persistent_misses
+            return {
+                "backend": self.backend(),
+                "n_programs": len(self.programs),
+                "n_entries": len({e for e, _ in self.programs}),
+                "calls": self.calls,
+                "tracing_hits": self.tracing_hits,
+                "tracing_misses": misses,
+                "tracing_hit_rate": (round(self.tracing_hits
+                                           / self.calls, 4)
+                                     if self.calls else None),
+                "backend_compiles": self.backend_compiles,
+                "backend_compile_s": round(self.backend_compile_s, 3),
+                "persistent_hits": self.persistent_hits,
+                "persistent_misses": self.persistent_misses,
+                "persistent_hit_rate": (round(self.persistent_hits
+                                              / p_total, 4)
+                                        if p_total else None),
+                "by_entry": by_entry,
+                "top": [[e, s, round(ms, 3)] for (e, s), ms in top],
+            }
+
+    def to_metrics(self, census: Optional[Dict[str, Any]] = None) -> None:
+        """Publish the census headline as pre-declared ``compile_*`` /
+        ``cache_*`` gauges (idempotent, like the QC aggregate)."""
+        from proovread_tpu.obs import metrics
+        if census is None:
+            census = self.census()
+        g = metrics.gauge
+        g("compile_programs", unit="programs").set(census["n_programs"])
+        g("compile_backend_compiles", unit="compiles").set(
+            census["backend_compiles"])
+        g("compile_backend_s", unit="s").set(census["backend_compile_s"])
+        g("compile_retraces", unit="traces").set(census["tracing_misses"])
+        g("cache_tracing_hit_rate", unit="frac").set(
+            census["tracing_hit_rate"] or 0.0)
+        g("cache_persistent_hit_rate", unit="frac").set(
+            census["persistent_hit_rate"] or 0.0)
+
+    # -- serialization -----------------------------------------------------
+    def write_jsonl(self, path: str,
+                    census: Optional[Dict[str, Any]] = None) -> None:
+        """One meta line (schema + embedded census), then one row per
+        compilation event — the ``--compile-ledger`` artifact."""
+        import json
+        if census is None:
+            census = self.census()
+        with self._lock:
+            rows = list(self.rows)
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"ledger_schema": LEDGER_SCHEMA_VERSION,
+                                 "backend": self.backend(),
+                                 "n_rows": len(rows),
+                                 "census": census}) + "\n")
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+
+    def report_lines(self,
+                     census: Optional[Dict[str, Any]] = None) -> List[str]:
+        """End-of-run census rendering (the span summary's sibling)."""
+        c = census if census is not None else self.census()
+        thr = (f"{c['tracing_hit_rate']:.1%}"
+               if c["tracing_hit_rate"] is not None else "n/a")
+        phr = (f"{c['persistent_hit_rate']:.1%}"
+               if c["persistent_hit_rate"] is not None else "off")
+        lines = [
+            f"compile: {c['n_programs']} program(s) across "
+            f"{c['n_entries']} entry point(s), "
+            f"{c['backend_compiles']} backend compile(s) / "
+            f"{c['backend_compile_s']:.3f}s",
+            f"compile: tracing-cache hit rate {thr} "
+            f"({c['tracing_hits']}/{c['calls']} calls), "
+            f"persistent-cache hit rate {phr} "
+            f"({c['persistent_hits']} hit / "
+            f"{c['persistent_misses']} miss)",
+        ]
+        for entry, sig, ms in c["top"][:5]:
+            lines.append(f"compile: top offender {entry} sig={sig} "
+                         f"{ms / 1e3:.3f}s")
+        return lines
+
+
+# -- module-level installation (mirrors obs.metrics / obs.qc) --------------
+
+_current: Optional[Ledger] = None
+_events_hook_installed = False
+
+
+def current() -> Optional[Ledger]:
+    return _current
+
+
+def enabled() -> bool:
+    return _current is not None
+
+
+def _install_cache_event_hook() -> None:
+    """ONE process-wide jax.monitoring event listener for the
+    persistent-cache counters, dispatching to the active ledger (same
+    no-unregister rationale as trace._install_monitoring_hook)."""
+    global _events_hook_installed
+    if _events_hook_installed:
+        return
+    _events_hook_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_event(event, **kw):
+            led = _current
+            if led is not None and not obs_trace._suspend_compile:
+                led._on_cache_event(event)
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:                                   # noqa: BLE001
+        log.debug("jax.monitoring unavailable — persistent-cache "
+                  "hit/miss attribution off")
+
+
+def install(ledger: Optional[Ledger] = None) -> Ledger:
+    global _current
+    _current = ledger if ledger is not None else Ledger()
+    obs_trace.set_ledger_compile_listener(_dispatch_backend_compile)
+    obs_trace._install_monitoring_hook()
+    _install_cache_event_hook()
+    return _current
+
+
+def uninstall() -> None:
+    global _current
+    _current = None
+    obs_trace.set_ledger_compile_listener(None)
+
+
+def _dispatch_backend_compile(duration: float) -> None:
+    led = _current
+    if led is not None:
+        led._on_backend_compile(duration)
+
+
+@contextmanager
+def scope(ledger: Optional[Ledger] = None):
+    """Scoped ledger installation (tests, smokes, bench configs) — same
+    reuse semantics as ``obs.metrics.scope``."""
+    global _current
+    if ledger is None and _current is not None:
+        yield _current
+        return
+    prev = _current
+    led = install(ledger)
+    try:
+        yield led
+    finally:
+        _current = prev
+        obs_trace.set_ledger_compile_listener(
+            _dispatch_backend_compile if prev is not None else None)
+
+
+def set_bucket(bucket: Optional[int]) -> None:
+    """Driver hook: label subsequent compile rows with the live length
+    bucket (one module-global read when the ledger is off)."""
+    led = _current
+    if led is not None:
+        led.set_bucket(bucket)
+
+
+# -- persistent compile cache (the ONE wiring point) -----------------------
+
+def default_cache_dir(backend: Optional[str] = None) -> str:
+    """Per-backend default persistent-cache directory — the directories
+    bench.py / parallel/smoke.py always used, now derived in one place:
+    ``<repo>/.jax_cache_cpu`` on CPU (the cache the test suite keeps
+    warm), ``<repo>/.jax_cache`` otherwise."""
+    import os
+
+    import proovread_tpu
+    if backend is None:
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:                               # noqa: BLE001
+            backend = "cpu"
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(proovread_tpu.__file__)))
+    return os.path.join(
+        root, ".jax_cache_cpu" if backend == "cpu" else ".jax_cache")
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None,
+                            backend: Optional[str] = None) -> str:
+    """First-class persistent-cache wiring (ROADMAP item 3): point jax's
+    compilation cache at ``cache_dir`` (default: the per-backend
+    :func:`default_cache_dir`) with the min-compile-time floor at 0 so
+    every program is cached. Returns the directory. ``cache_dir="auto"``
+    means the default too (the config-key spelling).
+
+    jax freezes the cache's enabled/disabled state at the FIRST compile
+    of the process, and importing this package compiles module-level
+    constants (``align/sw.py``'s ``jnp.float32`` literals land a
+    ``convert_element_type`` program) — so by the time a CLI flag is
+    parsed, the cache has already initialized itself as *disabled*.
+    ``reset_cache()`` drops it back to pristine so the next compile
+    re-reads the directory just configured; without this, the helper
+    silently does nothing for any caller that imported pipeline modules
+    first (which is every caller except a carefully-ordered bench)."""
+    import jax
+    if cache_dir in (None, "auto"):
+        cache_dir = default_cache_dir(backend)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        from jax._src import compilation_cache as _jax_cc
+        _jax_cc.reset_cache()
+    except Exception:                                   # noqa: BLE001
+        log.debug("compilation_cache.reset_cache unavailable — cache "
+                  "state frozen at first compile")
+    return cache_dir

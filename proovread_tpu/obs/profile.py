@@ -323,25 +323,59 @@ def profiling(profiler: Optional[Profiler] = None):
             prev._on_backend_compile if prev is not None else None)
 
 
-def attributed(name: Optional[str] = None):
+def attributed(name: Optional[str] = None,
+               sig_salt: Optional[str] = None):
     """Wrap a jitted entry point for lazy cost/memory attribution::
 
         @attributed("fused_accumulate")
         @functools.partial(jax.jit, ...)
         def fused_accumulate(...): ...
 
-    Off (no profiler installed) the wrapper costs one module-global read.
-    The underlying jit object stays reachable as ``fn.__wrapped__``.
+    Off (no profiler AND no compile ledger installed) the wrapper costs
+    two module-global reads. With a compile ledger
+    (``obs/compilecache.py``) installed, each call additionally reports
+    its entry name + abstract signature so compile events are attributed
+    to the program that triggered them (tracing-cache hit/miss
+    accounting rides the same window). ``sig_salt`` disambiguates
+    wrappers that share an entry name but wrap DIFFERENT programs whose
+    statics live in closures, not call args (the dmesh compile
+    chokepoint: align params / mesh shape are closure state of each
+    built step — without the salt, a second variant at the same array
+    shapes would be misread as a tracing-cache hit). The underlying jit
+    object stays reachable as ``fn.__wrapped__``.
     """
+    from proovread_tpu.obs import compilecache as obs_cc
+
     def deco(jfn):
         fn_name = name or getattr(jfn, "__name__", "jit_fn")
 
         @functools.wraps(jfn)
         def wrapper(*args, **kwargs):
             prof = _current
-            if prof is None:
+            led = obs_cc._current
+            if prof is None and led is None:
                 return jfn(*args, **kwargs)
-            return prof.call(fn_name, jfn, args, kwargs)
+            tok = None
+            if led is not None:
+                import jax
+                # inside another jit trace the call inlines into the
+                # outer program — that outer program owns the compile
+                if any(isinstance(leaf, jax.core.Tracer)
+                       for leaf in jax.tree_util.tree_leaves(
+                           (args, kwargs))):
+                    led = None
+                else:
+                    sig = obs_cc.signature(args, kwargs)
+                    if sig_salt is not None:
+                        sig = f"{sig_salt}.{sig}"
+                    tok = led.call_begin(fn_name, sig)
+            try:
+                if prof is None:
+                    return jfn(*args, **kwargs)
+                return prof.call(fn_name, jfn, args, kwargs)
+            finally:
+                if led is not None:
+                    led.call_end(tok)
 
         wrapper.__wrapped__ = jfn
         # forward the jit-object API callers rely on (tests clear the jit

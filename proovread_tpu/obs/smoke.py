@@ -140,14 +140,16 @@ def main(argv=None) -> int:
         trace = os.path.join(tmp, "run.trace.jsonl")
         mets = os.path.join(tmp, "run.metrics.json")
         qcp = os.path.join(tmp, "run.qc.jsonl")
+        ledp = os.path.join(tmp, "run.ledger.jsonl")
         cli_args = ["-l", lp, "-s", sp, "-p", out, "-m", "sr-noccs",
                     "-c", cfgp, "--qc-out", qcp]
         if qc_only:
             _log("running CLI with --qc-out (qc-smoke)")
         else:
-            _log("running CLI with --trace/--metrics-out/--qc-out "
-                 "(+ leak check)")
-            cli_args += ["--trace", trace, "--metrics-out", mets]
+            _log("running CLI with --trace/--metrics-out/--qc-out/"
+                 "--compile-ledger (+ leak check)")
+            cli_args += ["--trace", trace, "--metrics-out", mets,
+                         "--compile-ledger", ledp]
         from proovread_tpu.obs.memory import LeakCheck
         leak = LeakCheck()
         rc = cli_main(cli_args)
@@ -176,11 +178,30 @@ def main(argv=None) -> int:
             return 1
         if not _validate_qc_artifact(qcp, trace=trace):
             return 1
+        # compile ledger: strict schema + the ledger<->span-tree
+        # reconciliation (rows and the trace's compile split are fed by
+        # the same backend_compile monitoring events — they must agree)
+        from proovread_tpu.obs.validate import (reconcile_compile_ledger,
+                                                validate_compile_ledger)
+        try:
+            lstats = validate_compile_ledger(ledp)
+            rstats = reconcile_compile_ledger(ledp, trace)
+        except ValidationError as e:
+            _log(f"FAILED: {e}")
+            return 1
+        if lstats["census"]["calls"] < 1:
+            _log("FAILED: compile ledger saw no wrapped-entry calls "
+                 f"({json.dumps(lstats['census'])})")
+            return 1
         if lrep["leaked_bytes"] > 1 << 20:
             _log(f"FAILED: live-array leak after the run: {lrep}")
             return 1
         _log(f"trace OK: {json.dumps(tstats)}")
         _log(f"metrics OK: {json.dumps(mstats)}")
+        _log("compile-ledger OK: "
+             + json.dumps({k: v for k, v in lstats.items()
+                           if k != 'census'})
+             + f" reconciles {json.dumps(rstats)}")
         _log(f"leak check OK: {json.dumps(lrep)}")
         _log("PASS")
     return 0

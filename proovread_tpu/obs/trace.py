@@ -74,6 +74,9 @@ _suspend_compile = False
 # seconds from its per-call exec_s window); set via
 # set_profile_compile_listener so trace.py never imports profile
 _profile_compile_cb = None
+# obs.compilecache's backend-compile listener (the compile ledger records
+# one row per backend compile); same never-import contract
+_ledger_compile_cb = None
 
 
 def set_profile_active(on: bool) -> None:
@@ -84,6 +87,11 @@ def set_profile_active(on: bool) -> None:
 def set_profile_compile_listener(cb) -> None:
     global _profile_compile_cb
     _profile_compile_cb = cb
+
+
+def set_ledger_compile_listener(cb) -> None:
+    global _ledger_compile_cb
+    _ledger_compile_cb = cb
 
 
 def set_annotations(on: bool) -> None:
@@ -221,6 +229,8 @@ def _install_monitoring_hook() -> None:
                 t._on_compile(event, float(duration))
             if _profile_compile_cb is not None:
                 _profile_compile_cb(float(duration))
+            if _ledger_compile_cb is not None:
+                _ledger_compile_cb(float(duration))
 
         monitoring.register_event_duration_secs_listener(_on_duration)
     except Exception:                                   # noqa: BLE001

@@ -124,16 +124,179 @@ def validate_mesh_metrics(metrics: Dict[str, Any]) -> Dict[str, Any]:
     return {"mesh_passes": int(n_passes), "mesh_faults": int(n_faults)}
 
 
+# -- compile-ledger row schema (obs/compilecache.py writer) ----------------
+# Same declaration discipline as the QC schema: declared here,
+# independently of the writer, validated STRICTLY (an undeclared field
+# fails, a declared one missing fails), and a two-sided lint-guard test
+# (tests/test_compilecache.py) drives the writer against this declaration
+# so the two can never silently drift.
+LEDGER_SCHEMA_VERSION = 1
+LEDGER_ROW_FIELDS = {
+    "entry": (str,),               # entry point ((unattributed) fallback)
+    "sig": (str,),                 # abstract shape/dtype signature hash
+    "bucket": _OPT_INT,            # live length bucket, if any
+    "backend": (str,),
+    "kind": (str,),                # retrace | backend_compile
+    "wall_ms": _NUM,
+    "compile_ms": _NUM,            # backend-compile ms inside the window
+    "persistent_cache": (str, type(None)),   # hit | miss | null (off)
+}
+LEDGER_KINDS = ("retrace", "backend_compile")
+LEDGER_PCACHE = ("hit", "miss")
+# census keys the meta line must carry (obs/compilecache.py:Ledger.census)
+LEDGER_CENSUS_KEYS = (
+    "backend", "n_programs", "n_entries", "calls", "tracing_hits",
+    "tracing_misses", "tracing_hit_rate", "backend_compiles",
+    "backend_compile_s", "persistent_hits", "persistent_misses",
+    "persistent_hit_rate", "by_entry", "top")
+
+
+def validate_ledger_row(rec: Dict[str, Any], where: str = "row") -> None:
+    """Strictly validate ONE compile-ledger row: every declared field
+    present with an accepted type, no undeclared fields, values within
+    the closed vocabularies, and compile_ms == wall_ms for
+    backend_compile rows. Retrace rows deliberately have NO
+    compile<=wall containment check: under the serving layer's threads,
+    concurrent compiles attribute to the open call window and their
+    summed durations can legitimately exceed its wall time."""
+    if not isinstance(rec, dict):
+        _fail(f"{where}: not an object")
+    missing = [k for k in LEDGER_ROW_FIELDS if k not in rec]
+    if missing:
+        _fail(f"{where}: missing required fields {missing}")
+    unknown = [k for k in rec if k not in LEDGER_ROW_FIELDS]
+    if unknown:
+        _fail(f"{where}: undeclared fields {unknown} — declare them in "
+              "obs/validate.py:LEDGER_ROW_FIELDS first")
+    for k, types in LEDGER_ROW_FIELDS.items():
+        if not isinstance(rec[k], types):
+            _fail(f"{where}: field {k!r} has type "
+                  f"{type(rec[k]).__name__}, expected one of "
+                  f"{[t.__name__ for t in types]}")
+    if rec["kind"] not in LEDGER_KINDS:
+        _fail(f"{where}: kind {rec['kind']!r} outside {LEDGER_KINDS}")
+    if rec["persistent_cache"] is not None \
+            and rec["persistent_cache"] not in LEDGER_PCACHE:
+        _fail(f"{where}: persistent_cache {rec['persistent_cache']!r} "
+              f"outside {LEDGER_PCACHE}")
+    for k in ("wall_ms", "compile_ms"):
+        if rec[k] < 0:
+            _fail(f"{where}: {k} must be >= 0")
+    if rec["kind"] == "backend_compile" \
+            and rec["compile_ms"] != rec["wall_ms"]:
+        _fail(f"{where}: backend_compile row must have "
+              "compile_ms == wall_ms")
+
+
+def validate_compile_ledger(path: str, min_rows: int = 0
+                            ) -> Dict[str, Any]:
+    """Validate a ``--compile-ledger`` JSONL artifact: one meta line
+    (schema version + embedded census) then one strictly-validated row
+    per compilation event; the meta row count and the census
+    backend-compile totals must agree with the rows. Returns summary
+    stats (incl. the summed backend-compile ms — the number
+    :func:`reconcile_compile_ledger` checks against the span tree)."""
+    n = 0
+    backend_ms = 0.0
+    n_backend = 0
+    meta = None
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                _fail(f"{path}:{lineno}: not JSON ({e})")
+            if lineno == 1:
+                if not isinstance(obj, dict) \
+                        or obj.get("ledger_schema") != LEDGER_SCHEMA_VERSION:
+                    _fail(f"{path}: first line must be the meta record "
+                          f"with ledger_schema == {LEDGER_SCHEMA_VERSION}")
+                census = obj.get("census")
+                if not isinstance(census, dict):
+                    _fail(f"{path}: meta record lacks the census report")
+                miss = [k for k in LEDGER_CENSUS_KEYS if k not in census]
+                if miss:
+                    _fail(f"{path}: census lacks keys {miss}")
+                meta = obj
+                continue
+            validate_ledger_row(obj, where=f"{path}:{lineno}")
+            n += 1
+            if obj["kind"] == "backend_compile":
+                n_backend += 1
+                backend_ms += obj["wall_ms"]
+    if meta is None:
+        _fail(f"{path}: empty artifact (no meta line)")
+    if meta.get("n_rows") != n:
+        _fail(f"{path}: meta n_rows {meta.get('n_rows')} != "
+              f"{n} row line(s)")
+    census = meta["census"]
+    if census["backend_compiles"] != n_backend:
+        _fail(f"{path}: census backend_compiles "
+              f"{census['backend_compiles']} != {n_backend} "
+              "backend_compile row(s)")
+    if abs(census["backend_compile_s"] * 1e3 - backend_ms) > \
+            max(1.0, 0.001 * backend_ms):
+        _fail(f"{path}: census backend_compile_s "
+              f"{census['backend_compile_s']} disagrees with summed "
+              f"row compile ms {backend_ms:.3f}")
+    if n < min_rows:
+        _fail(f"{path}: {n} row(s) < required {min_rows}")
+    return {"n_rows": n, "n_backend_compiles": n_backend,
+            "backend_compile_ms": round(backend_ms, 3),
+            "n_programs": census["n_programs"],
+            "census": census}
+
+
+def reconcile_compile_ledger(ledger_path: str, trace_path: str,
+                             tolerance_frac: float = 0.05,
+                             tolerance_ms: float = 100.0
+                             ) -> Dict[str, Any]:
+    """The ledger and the span tree are fed by the SAME
+    ``backend_compile_duration`` monitoring events, so the ledger's
+    summed backend-compile ms must reconcile with the trace's depth-0
+    compile split (``make trace-smoke`` / ``make dmesh-smoke`` assert
+    this). Tolerances absorb the span layer's compile<=duration clamp."""
+    lstats = validate_compile_ledger(ledger_path)
+    tstats = validate_trace(trace_path)
+    trace_ms = tstats["compile_s"] * 1e3
+    ledger_ms = lstats["backend_compile_ms"]
+    diff = abs(trace_ms - ledger_ms)
+    if diff > max(tolerance_ms, tolerance_frac * max(trace_ms, ledger_ms)):
+        _fail(f"compile ledger {ledger_path} does not reconcile with "
+              f"trace {trace_path}: ledger {ledger_ms:.1f}ms vs trace "
+              f"root compile {trace_ms:.1f}ms (diff {diff:.1f}ms)")
+    return {"ledger_ms": round(ledger_ms, 3),
+            "trace_ms": round(trace_ms, 3), "diff_ms": round(diff, 3)}
+
+
 # -- serving SLO artifact schema (serve/server.py writer) ------------------
 # Same declaration discipline as the QC schema: declared here,
 # independently of the writer, and validated STRICTLY (undeclared fields
 # fail) so the serving layer can never silently drift its SLO contract.
-SLO_SCHEMA_VERSION = 1
+# v2 (PR 9): the required `compile` section joined the artifact — a
+# breaking schema change, versioned like every other schema here, so a
+# pre-PR-9 artifact fails with a clean version mismatch instead of a
+# misleading missing-field error
+SLO_SCHEMA_VERSION = 2
 _BOOL = (bool,)
 SLO_JOB_KEYS = ("accepted", "rejected", "journaled", "completed",
                 "failed", "cancelled", "expired")
 SLO_TOP_FIELDS = ("slo_schema", "jobs", "rejections", "queue", "latency",
-                  "demotions", "drain")
+                  "demotions", "drain", "compile")
+# compile-ledger census slice on the SLO artifact: the measurable form of
+# continuous batching's "keeps the fused programs hot" claim (ROADMAP
+# item 5) — n_programs/backend_compiles are the cold side, tracing
+# hits/misses the warm side, tracing_hit_rate the headline. Named
+# tracing_hit_rate, NOT cache_hit_rate: bench rows and COMPILE_*.json
+# use cache_hit_rate for the PERSISTENT-cache rate, and the serving
+# number is the in-process jit tracing rate — two different caches must
+# not share one key name
+SLO_COMPILE_KEYS = ("n_programs", "backend_compiles",
+                    "backend_compile_s", "tracing_hits",
+                    "tracing_misses", "tracing_hit_rate")
 SLO_LATENCY_KEYS = ("count", "p50_s", "p99_s", "max_s")
 SLO_QUEUE_KEYS = ("depth_peak", "depth_final")
 SLO_DRAIN_KEYS = ("requested", "clean")
@@ -459,6 +622,20 @@ def validate_slo(path: str, require_drained: bool = False
     if not isinstance(dem, dict) or any(
             not isinstance(v, int) or v < 0 for v in dem.values()):
         _fail(f"{path}: demotions must map tenant -> >=0 int")
+    comp = d["compile"]
+    if not isinstance(comp, dict) or \
+            sorted(comp) != sorted(SLO_COMPILE_KEYS):
+        _fail(f"{path}: compile must have exactly keys "
+              f"{SLO_COMPILE_KEYS}")
+    for k in SLO_COMPILE_KEYS:
+        v = comp[k]
+        if k == "tracing_hit_rate":
+            if v is not None and (not isinstance(v, _NUM)
+                                  or not 0.0 <= v <= 1.0):
+                _fail(f"{path}: compile.tracing_hit_rate must be null "
+                      "or in [0, 1]")
+        elif not isinstance(v, _NUM) or v < 0:
+            _fail(f"{path}: compile.{k} must be a >=0 number")
     drain = d["drain"]
     if not isinstance(drain, dict) or \
             sorted(drain) != sorted(SLO_DRAIN_KEYS):
@@ -483,6 +660,11 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", help="trace-event JSONL file")
     ap.add_argument("--metrics", help="metrics JSON file")
     ap.add_argument("--qc", help="per-read QC JSONL file (--qc-out)")
+    ap.add_argument("--compile-ledger", dest="compile_ledger",
+                    help="compile-ledger JSONL file (--compile-ledger); "
+                         "with --trace also checks that the ledger's "
+                         "backend-compile ms reconcile with the span "
+                         "tree's compile split")
     ap.add_argument("--slo", help="serving SLO artifact (serve --slo-out)")
     ap.add_argument("--require-drained", action="store_true",
                     help="SLO artifact must show a clean drain")
@@ -496,8 +678,10 @@ def main(argv=None) -> int:
     ap.add_argument("--require", default="",
                     help="comma-separated counter names that must exist")
     args = ap.parse_args(argv)
-    if not (args.trace or args.metrics or args.qc or args.slo):
-        ap.error("need --trace, --metrics, --qc and/or --slo")
+    if not (args.trace or args.metrics or args.qc or args.slo
+            or args.compile_ledger):
+        ap.error("need --trace, --metrics, --qc, --compile-ledger "
+                 "and/or --slo")
     try:
         if args.trace:
             stats = validate_trace(
@@ -512,6 +696,15 @@ def main(argv=None) -> int:
         if args.qc:
             stats = validate_qc(args.qc, min_reads=args.min_qc_reads)
             print(f"qc OK: {json.dumps({k: v for k, v in stats.items() if k != 'aggregate'})}")
+        if args.compile_ledger:
+            stats = validate_compile_ledger(args.compile_ledger)
+            print("compile-ledger OK: "
+                  + json.dumps({k: v for k, v in stats.items()
+                                if k != 'census'}))
+            if args.trace:
+                rstats = reconcile_compile_ledger(args.compile_ledger,
+                                                  args.trace)
+                print(f"compile-ledger reconciles: {json.dumps(rstats)}")
         if args.slo:
             stats = validate_slo(args.slo,
                                  require_drained=args.require_drained)

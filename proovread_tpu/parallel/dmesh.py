@@ -37,6 +37,7 @@ scan (tests/test_no_gather.py) therefore holds per shard by construction.
 
 from __future__ import annotations
 
+import itertools
 from typing import Optional
 
 import numpy as np
@@ -45,6 +46,10 @@ import jax.numpy as jnp
 
 from proovread_tpu.parallel import compat
 from proovread_tpu.parallel.compat import Mesh, PartitionSpec as P
+
+# ledger-signature salt sequence: one fresh value per chokepoint
+# compilation, deterministic for a deterministic build order
+_step_seq = itertools.count()
 from proovread_tpu.align import bsw, dseed
 from proovread_tpu.align.params import AlignParams
 from proovread_tpu.consensus.params import ConsensusParams
@@ -78,12 +83,24 @@ def compile_step_with_plan(body, mesh: Optional[Mesh] = None,
     body; any mesh -> ``shard_map`` (via the version shim) under ``jit``.
     Every mesh shape — full, shrunken-after-a-loss, single-device — goes
     through here, so there is exactly one place that knows how a step is
-    partitioned."""
+    partitioned — and exactly one place where every mesh program enters
+    the cost profiler AND the compile ledger (``obs/compilecache.py``):
+    the step is wrapped ``@attributed`` under a ``dmesh:`` name with a
+    per-compilation signature salt, so the program-zoo census sees each
+    (mesh shape, params, bucket shape) variant as its own program —
+    align/consensus params and the mesh are closure statics of the body,
+    invisible to the call-args signature, and without the salt a
+    recompiled variant at the same array shapes would be misread as a
+    tracing-cache hit."""
+    from proovread_tpu.obs.profile import attributed
+
+    step_name = f"dmesh:{getattr(body, '__name__', 'step')}"
+    salt = f"v{next(_step_seq)}"
     if mesh is None:
-        return jax.jit(body)
+        return attributed(step_name, sig_salt=salt)(jax.jit(body))
     mapped = compat.shard_map(body, mesh, in_specs=in_specs,
                               out_specs=out_specs, check_vma=check_vma)
-    return jax.jit(mapped)
+    return attributed(step_name, sig_salt=salt)(jax.jit(mapped))
 
 
 # compiled steps keyed by (device ids, params, statics) — a shrunken mesh

@@ -54,10 +54,10 @@ def _env_setup(n_devices: int = 4) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     jax.config.update("jax_platforms", "cpu")
-    cache = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))), ".jax_cache_cpu")
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # the ONE persistent-cache wiring point (obs/compilecache.py) —
+    # backend passed explicitly so the backend does not initialize here
+    from proovread_tpu.obs.compilecache import enable_persistent_cache
+    enable_persistent_cache(backend="cpu")
 
 
 def _log(msg: str) -> None:
@@ -154,13 +154,56 @@ def main(argv=None) -> int:
          f"{len(srs)} short reads, 2 length buckets")
 
     # -- phase 1: single-device baseline ---------------------------------
+    # UNtraced: the QC records the later byte-compares anchor on carry
+    # bucket_span ids only under tracing, so the reference run must stay
+    # exactly as instrumented as the faulted runs it is compared against
     agg0, recs0, res0 = _run(longs, srs)
     _log(f"baseline: {len(recs0)} QC records, "
          f"aggregate {len(agg0)} bytes")
 
+    # -- phase 1b: traced + compile-ledgered rerun ------------------------
+    # the mesh-tier check that ledger rows reconcile with the span
+    # tree's compile split (both are fed by the same monitoring events);
+    # a separate run so phase 1 stays the pristine comparison anchor
+    import tempfile as _tf
+
+    from proovread_tpu import obs
+    from proovread_tpu.obs import compilecache as obs_cc
+    from proovread_tpu.obs.validate import (reconcile_compile_ledger,
+                                            validate_compile_ledger)
+    with obs.tracing() as tr0, obs_cc.scope() as led0:
+        _, _, res0b = _run(longs, srs)
+    with _tf.TemporaryDirectory(prefix="proovread_dmesh_led_") as ltmp:
+        tracep = os.path.join(ltmp, "t.jsonl")
+        ledp = os.path.join(ltmp, "l.jsonl")
+        tr0.write_chrome(tracep)
+        led0.write_jsonl(ledp)
+        try:
+            lstats = validate_compile_ledger(ledp)
+            rstats = reconcile_compile_ledger(ledp, tracep)
+        except ValidationError as e:
+            _log(f"FAILED: compile ledger: {e}")
+            return 1
+    if res0b.compile_census is None \
+            or res0b.compile_census["calls"] < 1:
+        _log("FAILED: traced rerun's PipelineResult carries no compile "
+             "census")
+        return 1
+    _log("compile-ledger OK: "
+         + json.dumps({k: v for k, v in lstats.items() if k != 'census'})
+         + f" reconciles {json.dumps(rstats)}")
+
     # -- phase 2: headline — chip loss mid-iteration ----------------------
-    agg1, recs1, res1 = _run(longs, srs, mesh_shards=4,
-                             fault_spec=HEADLINE_FAULT)
+    # ledger on: the mesh path's programs must enter the census through
+    # the dmesh compile chokepoint (every sharded step is a dmesh: entry)
+    with obs_cc.scope() as led1:
+        agg1, recs1, res1 = _run(longs, srs, mesh_shards=4,
+                                 fault_spec=HEADLINE_FAULT)
+    if not any(e.startswith("dmesh:")
+               for e in led1.census()["by_entry"]):
+        _log("FAILED: mesh run's census carries no dmesh: entry "
+             f"({sorted(led1.census()['by_entry'])})")
+        return 1
     demotes = [r.note for r in res1.reports if r.task.startswith("demote")]
     if not any("mesh-dp3" in n and "shard 1" in n for n in demotes):
         _log(f"FAILED: {HEADLINE_FAULT} did not demote to mesh-dp3 "

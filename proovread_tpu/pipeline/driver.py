@@ -166,6 +166,11 @@ class PipelineResult:
     # support-depth / uplift histograms + the chimera/trim funnel.
     # Populated only while a QC recorder is installed (CLI --qc-out).
     qc: Optional[Dict[str, Any]] = None
+    # program-zoo census (obs/compilecache.py): distinct programs per
+    # entry point, backend-compile seconds, tracing/persistent cache hit
+    # rates. Populated only while a compile ledger is installed (CLI
+    # --compile-ledger, bench, serving).
+    compile_census: Optional[Dict[str, Any]] = None
 
 
 def _record_report(reports: List[TaskReport], rep: TaskReport) -> None:
@@ -240,6 +245,19 @@ def _declare_metrics(reg) -> None:
     reg.gauge("mesh_rebalanced_reads", "reads",
               "reads moved between shards by the last rebalance")
     reg.histogram("bucket_seconds", "s", "wall time per length bucket")
+    # compile-wall KPIs (obs/compilecache.py census): pre-declared so a
+    # run without the ledger still exposes the schema (zero-valued)
+    reg.gauge("compile_programs", "programs",
+              "distinct (entry point, shape-signature) programs traced")
+    reg.gauge("compile_backend_compiles", "compiles",
+              "XLA backend-compile events (persistent-cache hits incl.)")
+    reg.gauge("compile_backend_s", "s", "total backend-compile seconds")
+    reg.gauge("compile_retraces", "traces",
+              "tracing-cache misses across wrapped entry points")
+    reg.gauge("cache_tracing_hit_rate", "frac",
+              "wrapped-entry calls served by the in-process jit cache")
+    reg.gauge("cache_persistent_hit_rate", "frac",
+              "backend compiles served from the persistent XLA cache")
     # correction-QC aggregate gauges (obs/qc.py): pre-declared so a run
     # without --qc-out still exposes the schema (zero-valued)
     for key in QC_FUNNEL_KEYS:
@@ -447,6 +465,12 @@ class Pipeline:
                 # siamaera stage; gauges are idempotent)
                 result.qc = qc_rec.aggregate()
                 qc_rec.to_metrics(result.qc)
+            led = obs.compilecache.current()
+            if led is not None:
+                # embed the program-zoo census + publish the compile_* /
+                # cache_* gauges (idempotent, like the QC aggregate)
+                result.compile_census = led.census()
+                led.to_metrics(result.compile_census)
             result.metrics = reg.as_dict()
             return result
 
@@ -577,7 +601,10 @@ class Pipeline:
                 tb0 = time.monotonic()
                 # bases in the span args: per-bucket cost attribution
                 # (flops/bytes, obs/profile.py) normalizes to per-base
-                # rates without re-deriving read sizes from the journal
+                # rates without re-deriving read sizes from the journal.
+                # The compile ledger labels this bucket's compile rows
+                # (one module-global read when the ledger is off).
+                obs.compilecache.set_bucket(gi)
                 with obs.span("bucket", cat="bucket", bucket=gi, Lp=Lp,
                               reads=len(batch_recs),
                               bases=sum(len(r) for r in batch_recs)) \
@@ -602,6 +629,7 @@ class Pipeline:
                                 qc_records=(qc_rec.bucket_payload(
                                     [r.id for r in batch_recs])
                                     if qc_rec is not None else None))
+                obs.compilecache.set_bucket(None)
                 if hit is None:
                     # COMPUTED buckets only: replays would put ~0s rows in
                     # the latency histogram and make reads/bases disagree
@@ -637,6 +665,7 @@ class Pipeline:
                         continue
                 key = bucket_key(batch_recs)
                 tb0 = time.monotonic()
+                obs.compilecache.set_bucket(bi)
                 with obs.span("bucket", cat="bucket", bucket=bi,
                               reads=len(batch_recs),
                               bases=sum(len(r) for r in batch_recs)) \
@@ -661,6 +690,7 @@ class Pipeline:
                                 qc_records=(qc_rec.bucket_payload(
                                     [r.id for r in batch_recs])
                                     if qc_rec is not None else None))
+                obs.compilecache.set_bucket(None)
                 if hit is None:
                     _bucket_metrics(tb0, batch_recs)
                 results_final.extend(res_batch)

@@ -50,6 +50,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
     ap.add_argument("--engine", default="device",
                     choices=("device", "scan"),
                     help="correction engine (default: device)")
+    ap.add_argument("--compile-cache", metavar="DIR", nargs="?",
+                    const="auto",
+                    help="enable the persistent XLA compile cache at DIR "
+                         "(bare flag: the per-backend default `make "
+                         "prewarm` populates) — a prewarmed cache turns "
+                         "the server's first-wave compile wall into "
+                         "cache hits (docs/OBSERVABILITY.md 'Compile "
+                         "ledger & census')")
     ap.add_argument("--max-tenant-jobs", type=int, default=8,
                     help="per-tenant held-job quota (queued + running)")
     ap.add_argument("--max-tenant-bases", type=int, default=4_000_000,
@@ -91,6 +99,11 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     from proovread_tpu.pipeline.driver import PipelineConfig
     from proovread_tpu.serve.admission import TenantQuota
     from proovread_tpu.serve.server import CorrectionServer, ServeConfig
+
+    if args.compile_cache:
+        from proovread_tpu.obs.compilecache import enable_persistent_cache
+        log.info("serve: persistent XLA compile cache at %s",
+                 enable_persistent_cache(args.compile_cache))
 
     shorts = _read_records(args.short_reads)
     if not shorts:

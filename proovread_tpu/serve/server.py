@@ -139,6 +139,17 @@ class CorrectionServer:
             qc_recorder=self.qc_recorder,
             drain_after_buckets=config.drain_after_buckets)
 
+        # compile ledger for the server lifetime: continuous batching's
+        # "keeps the fused programs hot" claim (ROADMAP item 5) is only
+        # a claim until the SLO artifact carries the warm/cold program
+        # counts and the cache hit rate — the `stats` verb and
+        # --slo-out expose the census. Reuses an already-installed
+        # ledger (an embedding CLI's --compile-ledger wins), else
+        # installs its own and uninstalls it at drain.
+        from proovread_tpu.obs import compilecache
+        self._ledger_owned = compilecache.current() is None
+        self.ledger = compilecache.current() or compilecache.install()
+
         self._threads: List[threading.Thread] = []
         self._listener: Optional[socket.socket] = None
         if config.resume:
@@ -587,6 +598,7 @@ class CorrectionServer:
         self._close_listener()
         if self.cfg.slo_path:
             self.write_slo(self.cfg.slo_path)
+        self._release_ledger()
         return self._drain_clean
 
     def serve_forever(self) -> bool:
@@ -604,7 +616,19 @@ class CorrectionServer:
         self._close_listener()
         if self.cfg.slo_path:
             self.write_slo(self.cfg.slo_path)
+        self._release_ledger()
         return self._drain_clean
+
+    def _release_ledger(self) -> None:
+        """Drop the process-global ledger installation IF this server
+        owns it (an in-process host keeping several servers must not
+        have a drained one swallow a live one's events). The Ledger
+        object itself stays readable for late slo_snapshot calls."""
+        if self._ledger_owned:
+            from proovread_tpu.obs import compilecache
+            if compilecache.current() is self.ledger:
+                compilecache.uninstall()
+            self._ledger_owned = False
 
     # -- socket transport --------------------------------------------------
     def _listen(self) -> None:
@@ -708,8 +732,17 @@ class CorrectionServer:
                   "p99_s": round(float(np.percentile(vs, 99)), 6),
                   "max_s": round(float(max(vs)), 6)}
             for cls, vs in sorted(lat.items())}
+        # program-zoo slice (obs/compilecache.py): n_programs /
+        # backend_compiles are the cold side of the serving lifetime,
+        # tracing hits the warm side — the measurable form of "continuous
+        # batching keeps the fused programs hot". tracing_hit_rate is
+        # the fraction of entry-point calls served without retracing
+        # anything (deliberately NOT named cache_hit_rate — bench/COMPILE
+        # rows use that for the persistent-cache rate).
+        from proovread_tpu.obs.validate import SLO_SCHEMA_VERSION
+        c = self.ledger.census()
         return {
-            "slo_schema": 1,
+            "slo_schema": SLO_SCHEMA_VERSION,
             "jobs": {"accepted": len(jobs), "rejected":
                      sum(rejections.values()), "journaled": journaled,
                      **counts},
@@ -718,6 +751,12 @@ class CorrectionServer:
                       "depth_final": depth_final},
             "latency": latency,
             "demotions": demotions,
+            "compile": {"n_programs": c["n_programs"],
+                        "backend_compiles": c["backend_compiles"],
+                        "backend_compile_s": c["backend_compile_s"],
+                        "tracing_hits": c["tracing_hits"],
+                        "tracing_misses": c["tracing_misses"],
+                        "tracing_hit_rate": c["tracing_hit_rate"]},
             "drain": {"requested": self._drain.is_set(),
                       "clean": self._drain_clean},
         }

@@ -1,0 +1,513 @@
+"""PR-9 compile-wall observability tests: the compile ledger
+(obs/compilecache.py), its strict row schema + two-sided drift guard
+(obs/validate.py:LEDGER_ROW_FIELDS), the zero-overhead-when-off tier-1
+guard, the program-zoo census falsifiability (a planted extra shape
+variant must bump the program count), the ledger<->trace reconciliation,
+the `make compile-check` gate verdicts (obs/census.py), and the serving
+SLO artifact's compile section (docs/OBSERVABILITY.md "Compile ledger &
+census")."""
+
+import functools
+import json
+
+import numpy as np
+import pytest
+
+from proovread_tpu import obs
+from proovread_tpu.obs import census as obs_census
+from proovread_tpu.obs import compilecache as obs_cc
+from proovread_tpu.obs import profile as obsp
+from proovread_tpu.obs.validate import (LEDGER_ROW_FIELDS,
+                                        ValidationError,
+                                        reconcile_compile_ledger,
+                                        validate_compile_ledger,
+                                        validate_ledger_row,
+                                        validate_slo)
+
+
+def _toy_entry(tag="toy_cc"):
+    import jax
+
+    @obsp.attributed(tag)
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def toy(a, k: int = 1):
+        return a * 2 + k
+    return toy
+
+
+def _drive_all_writer_paths(led: obs_cc.Ledger) -> None:
+    """Exercise every row-emitting path synthetically: a fresh-signature
+    call whose window sees a persistent-cache miss compile, one whose
+    compile is a persistent hit, one with the cache off, an unattributed
+    backend compile, and a tracing-cache hit (no row)."""
+    tok = led.call_begin("entry_a", "sig1")
+    led._on_cache_event(obs_cc._CACHE_REQUEST_EVENT)
+    led._on_backend_compile(0.25)               # pcache miss
+    led.call_end(tok)
+    tok = led.call_begin("entry_a", "sig2")
+    led._on_cache_event(obs_cc._CACHE_REQUEST_EVENT)
+    led._on_cache_event(obs_cc._CACHE_HIT_EVENT)
+    led._on_backend_compile(0.01)               # pcache hit
+    led.call_end(tok)
+    led.set_bucket(3)
+    tok = led.call_begin("entry_b", "sig1")
+    led._on_backend_compile(0.1)                # cache off -> null
+    led.call_end(tok)
+    led.set_bucket(None)
+    led._on_backend_compile(0.05)               # unattributed
+    assert led.call_begin("entry_a", "sig1") is None   # tracing hit
+
+
+class TestLedgerSchema:
+    def test_schema_never_drifts(self, tmp_path):
+        """Lint guard (QC-schema pattern): drive every writer path, then
+        strictly validate — a field the writer emits that is not declared
+        in obs/validate.py:LEDGER_ROW_FIELDS fails, and a declared field
+        the writer stops emitting fails. Two-sided by construction:
+        validate_ledger_row checks both directions and the row sets are
+        compared exactly."""
+        led = obs_cc.Ledger(backend="cpu")
+        _drive_all_writer_paths(led)
+        assert led.rows, "writer emitted no rows"
+        for r in led.rows:
+            validate_ledger_row(r)
+            assert set(r) == set(LEDGER_ROW_FIELDS)
+        p = str(tmp_path / "ledger.jsonl")
+        led.write_jsonl(p)
+        stats = validate_compile_ledger(p, min_rows=4)
+        assert stats["n_backend_compiles"] == 4
+        assert stats["n_programs"] == 3
+
+    def test_bucket_label_rides_rows(self):
+        led = obs_cc.Ledger(backend="cpu")
+        _drive_all_writer_paths(led)
+        by_entry = {r["entry"]: r for r in led.rows
+                    if r["kind"] == "backend_compile"}
+        assert by_entry["entry_b"]["bucket"] == 3
+        assert by_entry["entry_a"]["bucket"] is None
+
+    def test_persistent_cache_classification(self):
+        led = obs_cc.Ledger(backend="cpu")
+        _drive_all_writer_paths(led)
+        pc = [r["persistent_cache"] for r in led.rows
+              if r["kind"] == "backend_compile"]
+        assert pc == ["miss", "hit", None, None]
+        c = led.census()
+        assert c["persistent_hits"] == 1 and c["persistent_misses"] == 1
+        assert c["persistent_hit_rate"] == 0.5
+
+    def test_census_math(self):
+        led = obs_cc.Ledger(backend="cpu")
+        _drive_all_writer_paths(led)
+        c = led.census()
+        assert c["n_programs"] == 3 and c["n_entries"] == 2
+        assert c["calls"] == 4 and c["tracing_hits"] == 1
+        assert c["tracing_misses"] == 3
+        assert c["tracing_hit_rate"] == 0.25
+        assert c["backend_compiles"] == 4
+        assert c["by_entry"]["entry_a"]["programs"] == 2
+        assert c["by_entry"]["entry_a"]["calls"] == 3
+        # top offenders sorted by compile ms, worst first
+        assert c["top"][0][:2] == ["entry_a", "sig1"]
+
+    def _row(self):
+        led = obs_cc.Ledger(backend="cpu")
+        tok = led.call_begin("e", "s")
+        led._on_backend_compile(0.1)
+        led.call_end(tok)
+        return dict(led.rows[0])
+
+    def test_undeclared_field_fails(self):
+        r = self._row()
+        r["sneaky"] = 1
+        with pytest.raises(ValidationError, match="undeclared"):
+            validate_ledger_row(r)
+
+    def test_missing_field_fails(self):
+        r = self._row()
+        del r["sig"]
+        with pytest.raises(ValidationError, match="missing required"):
+            validate_ledger_row(r)
+
+    def test_bad_vocab_and_invariants_fail(self):
+        r = self._row()
+        r["kind"] = "teleport"
+        with pytest.raises(ValidationError, match="kind"):
+            validate_ledger_row(r)
+        r = self._row()
+        r["persistent_cache"] = "maybe"
+        with pytest.raises(ValidationError, match="persistent_cache"):
+            validate_ledger_row(r)
+        r = self._row()
+        r["wall_ms"] = "fast"
+        with pytest.raises(ValidationError, match="type"):
+            validate_ledger_row(r)
+        r = self._row()
+        r["compile_ms"] = r["wall_ms"] + 1          # backend row equality
+        with pytest.raises(ValidationError, match="compile_ms == wall"):
+            validate_ledger_row(r)
+
+    def test_artifact_meta_consistency(self, tmp_path):
+        led = obs_cc.Ledger(backend="cpu")
+        _drive_all_writer_paths(led)
+        p = str(tmp_path / "ledger.jsonl")
+        led.write_jsonl(p)
+        lines = open(p).read().splitlines()
+        meta = json.loads(lines[0])
+        meta["n_rows"] += 1
+        with open(p, "w") as fh:
+            fh.write(json.dumps(meta) + "\n")
+            fh.write("\n".join(lines[1:]) + "\n")
+        with pytest.raises(ValidationError, match="n_rows"):
+            validate_compile_ledger(p)
+
+
+# --------------------------------------------------------------------------
+# tier-1 zero-overhead guard + falsifiability
+# --------------------------------------------------------------------------
+
+def test_compile_ledger_zero_overhead_when_off(monkeypatch):
+    """With no ledger installed, a pipeline run must compute no
+    signatures and touch no ledger state — the timed bench path relies
+    on the off path being two module-global reads. Any call into the
+    ledger machinery fails the test."""
+    from proovread_tpu.io.records import SeqRecord
+    from proovread_tpu.ops.encode import decode_codes
+    from proovread_tpu.pipeline import Pipeline, PipelineConfig, TrimParams
+
+    def _boom(*a, **k):                                 # noqa: ANN001
+        raise AssertionError("compile-ledger machinery ran while off")
+
+    monkeypatch.setattr(obs_cc.Ledger, "call_begin", _boom)
+    monkeypatch.setattr(obs_cc.Ledger, "_on_backend_compile", _boom)
+    monkeypatch.setattr(obs_cc, "signature", _boom)
+
+    assert obs_cc.current() is None
+    rng = np.random.default_rng(17)
+    genome = rng.integers(0, 4, 400).astype(np.int8)
+    longs = [SeqRecord(f"r{i}", decode_codes(genome[s:s + 200]))
+             for i, s in enumerate((0, 100))]
+    srs = [SeqRecord(f"s{i}", decode_codes(genome[s:s + 100]),
+                     qual=np.full(100, 30, np.uint8))
+           for i, s in enumerate(rng.integers(0, 300, 30))]
+    res = Pipeline(PipelineConfig(
+        mode="sr", n_iterations=1, sampling=False, engine="scan",
+        batch_reads=8, trim=TrimParams(min_length=100))).run(longs, srs)
+    assert len(res.untrimmed) == 2
+    # and the census stayed out of the result + the compile_* gauges
+    # exist pre-declared but zero-valued (schema stability)
+    assert res.compile_census is None
+    assert res.metrics["gauges"]["compile_programs"]["series"] == []
+
+
+def test_shape_variant_bumps_census():
+    """Falsifiability: planting an extra shape variant at a wrapped
+    entry point must bump the census' distinct-program count — if it
+    does not, the ledger is not actually keyed on the abstract
+    signature and the program-zoo numbers are fiction."""
+    import jax.numpy as jnp
+    toy = _toy_entry("toy_variant")
+    with obs_cc.scope() as led:
+        toy(jnp.ones(8))
+        toy(jnp.ones(8))                    # tracing-cache hit
+        base = led.census()["n_programs"]
+        toy(jnp.ones(16))                   # planted extra shape variant
+        c = led.census()
+    assert base == 1
+    assert c["n_programs"] == 2
+    assert c["calls"] == 3 and c["tracing_hits"] == 1
+    sigs = {r["sig"] for r in led.rows if r["kind"] == "retrace"}
+    assert len(sigs) == 2
+
+
+def test_static_arg_is_part_of_program_identity():
+    """A static-argument change recompiles the program, so it must count
+    as a new signature too."""
+    import jax.numpy as jnp
+    toy = _toy_entry("toy_static")
+    with obs_cc.scope() as led:
+        toy(jnp.ones(8), k=1)
+        toy(jnp.ones(8), k=2)
+    assert led.census()["n_programs"] == 2
+
+
+def test_mesh_chokepoint_feeds_ledger():
+    """dmesh.compile_step_with_plan is a ledger entry point: a step
+    compiled through the chokepoint shows up in the census under its
+    dmesh: name (the mesh program zoo is part of the wall)."""
+    import jax.numpy as jnp
+
+    from proovread_tpu.parallel.dmesh import compile_step_with_plan
+
+    def my_step(x):
+        return x + 1
+
+    step = compile_step_with_plan(my_step)      # no mesh -> plain jit
+    with obs_cc.scope() as led:
+        step(jnp.ones(8))
+    c = led.census()
+    assert "dmesh:my_step" in c["by_entry"]
+    assert c["by_entry"]["dmesh:my_step"]["programs"] == 1
+
+
+def test_mesh_step_variants_are_distinct_programs():
+    """Two chokepoint-compiled steps whose differences live in closure
+    statics (align params, mesh shape) share an entry name and can share
+    array shapes — the signature salt must still count them as distinct
+    census programs, or a recompiled variant reads as a tracing-cache
+    hit and the mesh zoo undercounts."""
+    import jax.numpy as jnp
+
+    from proovread_tpu.parallel.dmesh import compile_step_with_plan
+
+    def my_step(x):                     # stand-in for params variant A
+        return x + 1
+
+    step_a = compile_step_with_plan(my_step)
+
+    def my_step(x):                     # same name, different closure/body
+        return x + 2
+
+    step_b = compile_step_with_plan(my_step)
+    with obs_cc.scope() as led:
+        step_a(jnp.ones(8))
+        step_b(jnp.ones(8))             # identical call-arg shapes
+    c = led.census()
+    assert c["by_entry"]["dmesh:my_step"]["programs"] == 2
+    assert c["tracing_hits"] == 0
+
+
+# --------------------------------------------------------------------------
+# ledger <-> span tree reconciliation
+# --------------------------------------------------------------------------
+
+class TestReconciliation:
+    def test_ledger_reconciles_with_trace(self, tmp_path):
+        """Both are fed by the same backend_compile_duration events, so
+        the ledger's summed compile ms must match the trace's depth-0
+        compile split."""
+        import jax.numpy as jnp
+        toy = _toy_entry("toy_reconcile")
+        with obs.tracing() as tr, obs_cc.scope() as led:
+            with obs.span("run", cat="run"):
+                with obs.span("b0", cat="bucket", bucket=0) as sp:
+                    sp.fence(toy(jnp.ones(32)))
+        trace = str(tmp_path / "t.jsonl")
+        ledger = str(tmp_path / "l.jsonl")
+        tr.write_chrome(trace)
+        led.write_jsonl(ledger)
+        stats = reconcile_compile_ledger(ledger, trace)
+        assert stats["diff_ms"] <= max(100.0, 0.05 * stats["ledger_ms"])
+
+    def test_reconcile_flags_divergence(self, tmp_path):
+        """An inflated ledger (or an untraced compile) must fail the
+        reconciliation — the smokes rely on this firing."""
+        import jax.numpy as jnp
+        toy = _toy_entry("toy_diverge")
+        with obs.tracing() as tr:
+            with obs.span("run", cat="run"):
+                toy(jnp.ones(32))
+        trace = str(tmp_path / "t.jsonl")
+        tr.write_chrome(trace)
+        led = obs_cc.Ledger(backend="cpu")
+        led._on_backend_compile(10.0)           # 10s the trace never saw
+        ledger = str(tmp_path / "l.jsonl")
+        led.write_jsonl(ledger)
+        with pytest.raises(ValidationError, match="reconcile"):
+            reconcile_compile_ledger(ledger, trace)
+
+
+# --------------------------------------------------------------------------
+# the compile-check gate (obs/census.py)
+# --------------------------------------------------------------------------
+
+def _census_row(config=4, backend="cpu", warm_s=0.1, nprog=40,
+                rate=0.98, cold_s=120.0):
+    return {"metric": "compile_census", "schema": 1, "config": config,
+            "backend": backend, "cap_bases": None, "n_reads": 6,
+            "total_bases": 44880, "cache_dir": "x",
+            "cold": {"wall_s": 400.0, "compile_s": cold_s,
+                     "n_programs": nprog, "backend_compiles": nprog,
+                     "persistent_hit_rate": 0.0},
+            "warm": {"wall_s": 350.0, "compile_s": warm_s,
+                     "n_programs": nprog, "backend_compiles": nprog,
+                     "persistent_hit_rate": rate},
+            "cache_hit_rate": rate}
+
+
+def _entries(rows):
+    return [{"source": f"COMPILE_r{i:02d}.json", "row": r}
+            for i, r in enumerate(rows)]
+
+
+class TestCompileCheckGate:
+    def test_pass_on_stable_history(self):
+        v = obs_census.compile_check(_entries(
+            [_census_row(), _census_row(), _census_row()]))
+        assert v["verdict"] == "PASS"
+        assert any(c["status"] == "ok" for c in v["checks"])
+
+    def test_first_row_skips(self):
+        v = obs_census.compile_check(_entries([_census_row()]))
+        assert v["verdict"] == "PASS"
+        assert any(c["status"] == "skipped" for c in v["checks"])
+
+    def test_extra_program_regresses(self):
+        v = obs_census.compile_check(_entries(
+            [_census_row(), _census_row(),
+             _census_row(nprog=42)]))                  # planted variants
+        assert v["verdict"] == "REGRESSION"
+        assert any(c["status"] == "regressed"
+                   and "n_programs" in c["check"] for c in v["checks"])
+
+    def test_slower_warm_compile_regresses(self):
+        v = obs_census.compile_check(_entries(
+            [_census_row(), _census_row(),
+             _census_row(warm_s=5.0)]))                # cache went cold
+        assert v["verdict"] == "REGRESSION"
+        assert any(c["status"] == "regressed"
+                   and "warm_compile_s" in c["check"]
+                   for c in v["checks"])
+
+    def test_forced_cache_miss_regresses(self):
+        v = obs_census.compile_check(_entries(
+            [_census_row(), _census_row(),
+             _census_row(rate=0.5, warm_s=0.1)]))
+        assert v["verdict"] == "REGRESSION"
+        assert any(c["status"] == "regressed"
+                   and "cache_hit_rate" in c["check"]
+                   for c in v["checks"])
+
+    def test_pools_never_cross_backends(self):
+        """A CPU row must not regress against a TPU baseline (the
+        obs/regress.py pooling rule)."""
+        v = obs_census.compile_check(_entries(
+            [_census_row(backend="tpu", nprog=3200, warm_s=0.2),
+             _census_row(backend="cpu", nprog=40)]))
+        assert v["verdict"] == "PASS"
+        assert sum(1 for c in v["checks"]
+                   if c["status"] == "skipped") >= 2
+
+    def test_small_warm_jitter_passes(self):
+        """Sub-min-abs growth on a near-zero warm baseline is noise,
+        not a regression."""
+        v = obs_census.compile_check(_entries(
+            [_census_row(warm_s=0.05), _census_row(warm_s=0.08),
+             _census_row(warm_s=0.3)]))
+        assert v["verdict"] == "PASS"
+
+    def test_load_rows_json_lines(self, tmp_path):
+        p = tmp_path / "COMPILE_r01.json"
+        with open(p, "w") as fh:
+            fh.write(json.dumps(_census_row()) + "\n")
+            fh.write(json.dumps(_census_row(config=3)) + "\n")
+        rows = obs_census.load_rows([str(p)])
+        assert len(rows) == 2
+        assert {r["row"]["config"] for r in rows} == {3, 4}
+
+
+# --------------------------------------------------------------------------
+# serving SLO artifact: the compile section
+# --------------------------------------------------------------------------
+
+def _slo_doc():
+    return {"slo_schema": 2,
+            "jobs": {"accepted": 0, "rejected": 0, "journaled": 0,
+                     "completed": 0, "failed": 0, "cancelled": 0,
+                     "expired": 0},
+            "rejections": {}, "queue": {"depth_peak": 0,
+                                        "depth_final": 0},
+            "latency": {}, "demotions": {},
+            "compile": {"n_programs": 12, "backend_compiles": 14,
+                        "backend_compile_s": 3.5, "tracing_hits": 88,
+                        "tracing_misses": 12, "tracing_hit_rate": 0.88},
+            "drain": {"requested": False, "clean": False}}
+
+
+class TestSloCompileSection:
+    def _check(self, tmp_path, doc):
+        p = str(tmp_path / "slo.json")
+        with open(p, "w") as fh:
+            json.dump(doc, fh)
+        return validate_slo(p)
+
+    def test_valid(self, tmp_path):
+        self._check(tmp_path, _slo_doc())
+
+    def test_null_rate_valid(self, tmp_path):
+        d = _slo_doc()
+        d["compile"]["tracing_hit_rate"] = None
+        self._check(tmp_path, d)
+
+    def test_missing_section_fails(self, tmp_path):
+        d = _slo_doc()
+        del d["compile"]
+        with pytest.raises(ValidationError, match="missing"):
+            self._check(tmp_path, d)
+
+    def test_wrong_keys_fail(self, tmp_path):
+        d = _slo_doc()
+        d["compile"]["warm_fuzzies"] = 1
+        with pytest.raises(ValidationError, match="compile"):
+            self._check(tmp_path, d)
+
+    def test_bad_rate_fails(self, tmp_path):
+        d = _slo_doc()
+        d["compile"]["tracing_hit_rate"] = 1.5
+        with pytest.raises(ValidationError, match="tracing_hit_rate"):
+            self._check(tmp_path, d)
+
+
+# --------------------------------------------------------------------------
+# CLI artifact end-to-end (scan engine: cheap, no interpret-mode Pallas)
+# --------------------------------------------------------------------------
+
+class TestCliLedgerArtifact:
+    def _workload(self, tmp_path):
+        from proovread_tpu.io.fastq import FastqWriter
+        from proovread_tpu.io.records import SeqRecord
+        from proovread_tpu.ops.encode import decode_codes
+        rng = np.random.default_rng(23)
+        genome = rng.integers(0, 4, 400).astype(np.int8)
+        longs = [SeqRecord(f"r{i}", decode_codes(genome[s:s + 200]),
+                           qual=np.full(200, 20, np.uint8))
+                 for i, s in enumerate((0, 100))]
+        srs = [SeqRecord(f"s{i}", decode_codes(genome[s:s + 100]),
+                         qual=np.full(100, 30, np.uint8))
+               for i, s in enumerate(rng.integers(0, 300, 40))]
+        lp, sp = str(tmp_path / "l.fq"), str(tmp_path / "s.fq")
+        for path, recs in ((lp, longs), (sp, srs)):
+            with open(path, "wb") as fh:
+                w = FastqWriter(fh)
+                for r in recs:
+                    w.write(r)
+        cfg = str(tmp_path / "c.cfg")
+        with open(cfg, "w") as fh:
+            json.dump({"engine": "scan", "batch-reads": 8,
+                       "seq-filter": {"--min-length": 100}}, fh)
+        return lp, sp, cfg
+
+    def test_artifact_written_and_valid(self, tmp_path):
+        from proovread_tpu.cli import main as cli_main
+        lp, sp, cfg = self._workload(tmp_path)
+        led = str(tmp_path / "run.ledger.jsonl")
+        rc = cli_main(["-l", lp, "-s", sp, "-p", str(tmp_path / "out"),
+                       "-m", "sr-noccs", "-c", cfg,
+                       "--compile-ledger", led])
+        assert rc == 0
+        stats = validate_compile_ledger(led)
+        assert stats["census"]["backend"] == "cpu"
+        # the global installation is unwound even though the artifact
+        # was written
+        assert obs_cc.current() is None
+
+    def test_no_artifact_when_off(self, tmp_path):
+        from proovread_tpu.cli import main as cli_main
+        lp, sp, cfg = self._workload(tmp_path)
+        led = str(tmp_path / "run.ledger.jsonl")
+        rc = cli_main(["-l", lp, "-s", sp, "-p", str(tmp_path / "out2"),
+                       "-m", "sr-noccs", "-c", cfg])
+        assert rc == 0
+        import os
+        assert not os.path.exists(led)
+        assert obs_cc.current() is None
