@@ -11,9 +11,12 @@
 #   make prewarm      populate the persistent compile cache (cold+warm runs)
 #                     and record a COMPILE_*.json census row per config
 #   make compile-check  cold-start regression gate over COMPILE_*.json
+#   make accuracy-record  score truth-sidecar CLI runs (config-3 slice,
+#                     config 4, the 4-way dmesh workload) into ACCURACY rows
+#   make accuracy-check   identity floor + no-regression gate over ACCURACY_*.json
 #   make bench        the benchmark itself (one JSON row on stdout)
 
-.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke perf-check perf-report prewarm compile-check bench
+.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke perf-check perf-report prewarm compile-check accuracy-record accuracy-check bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -104,6 +107,28 @@ prewarm:
 # this green (PERF.md).
 compile-check:
 	python -m proovread_tpu.obs.census check
+
+# accuracy scoreboard (docs/OBSERVABILITY.md "Accuracy scoreboard"): run
+# the simulated workloads through the real CLI with their truth sidecars
+# (--truth) and append one ACCURACY row per workload — config 3 under its
+# pinned prewarm scaled-slice cap, config 4, and the dmesh-smoke
+# shard-exact workload through --mesh-shards 4 on a simulated 4-way CPU
+# mesh. Rows append to $(ACCURACY_OUT).
+# Usage: make accuracy-record [WORKLOADS=3,4,dmesh] [ACCURACY_OUT=ACCURACY_r11.json]
+WORKLOADS ?= 3,4,dmesh
+ACCURACY_OUT ?= ACCURACY_record.json
+accuracy-record:
+	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.accuracy record \
+		--workloads $(WORKLOADS) --out $(ACCURACY_OUT)
+
+# identity-regression gate: every (config, backend, mesh) pool's newest
+# ACCURACY_*.json row must clear the absolute identity floor, show uplift
+# (identity_after >= identity_before) and stay within the no-regression
+# delta of its rolling baseline. Exits 1 and prints ACCURACY-REGRESSION
+# lines on any breach — perf PRs must show this green next to
+# `make perf-check` (PERF.md quality gate).
+accuracy-check:
+	python -m proovread_tpu.obs.accuracy check
 
 # PERF.md-style trajectory / phase / kernel-attribution tables, generated
 # from the same history instead of hand-assembled op traces

@@ -10,6 +10,12 @@ Outputs (reference layout, ``bin/proovread:904-956``):
 ``.ignored.tsv``, ``.chim.tsv``, plus ``.parameter.log`` (``:401-416``) and
 per-task wall-times on stderr.
 
+Accuracy (docs/OBSERVABILITY.md "Accuracy scoreboard"): ``--truth
+FILE`` scores every corrected read against its error-free source from a
+simulator-emitted truth sidecar after the run and merges the verdicts
+into the per-read QC records, the QC aggregate and the ``accuracy_*``
+gauges.
+
 Observability (docs/OBSERVABILITY.md): ``--trace FILE`` writes the span
 tree as Chrome trace-event JSONL (loadable in Perfetto) and logs an
 end-of-run summary table, a per-kernel cost/memory roofline, and a
@@ -117,6 +123,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "trajectory, support depth, corrected bases, "
                          "chimera/siamaera/trim funnel — "
                          "docs/OBSERVABILITY.md)")
+    ap.add_argument("--truth", metavar="FILE",
+                    help="ground-truth sidecar JSONL (io/simulate.py:"
+                         "write_truth_sidecar): after the run, score "
+                         "every read's identity before/after vs its "
+                         "error-free source (plus residual sub/ins/del "
+                         "classes and chimera-detection correctness) "
+                         "and merge the verdicts into the per-read QC "
+                         "records, the QC aggregate and the accuracy_* "
+                         "gauges — docs/OBSERVABILITY.md 'Accuracy "
+                         "scoreboard'")
     ap.add_argument("--compile-ledger", metavar="FILE",
                     help="write the compile ledger as JSONL — one "
                          "strict-schema row per XLA compilation event "
@@ -294,6 +310,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     trace_path = args.trace or cfg.get("trace-file")
     metrics_path = args.metrics_out or cfg.get("metrics-out")
     qc_path = args.qc_out or cfg.get("qc-out")
+    truth_path = args.truth or cfg.get("truth-sidecar")
     ledger_path = args.compile_ledger or cfg.get("compile-ledger")
     cache_dir = args.compile_cache or cfg.get("compile-cache-dir")
     if cache_dir:
@@ -305,7 +322,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     profiler = obs.profile.install() if tracing_on else None
     mem_sampler = obs.memory.install() if tracing_on else None
     leak_check = obs.memory.LeakCheck() if tracing_on else None
-    qc_recorder = obs.qc.install() if qc_path else None
+    # --truth scores into the per-read QC records, so it brings the
+    # recorder with it even without a --qc-out artifact (the aggregate
+    # still lands in PipelineResult.qc and the accuracy_* gauges)
+    qc_recorder = obs.qc.install() if (qc_path or truth_path) else None
     ledger = obs.compilecache.install() if ledger_path else None
     xprof_cm = None
     if args.xprof:
@@ -339,7 +359,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     t_start = time.monotonic()
     try:
-        rc = _run(args, argv, cfg, outdir, name, ckpt_dir, mode_auto)
+        rc = _run(args, argv, cfg, outdir, name, ckpt_dir, mode_auto,
+                  truth_path)
     finally:
         # write the artifacts even on a crashed run — the partial span
         # tree (which bucket/pass was live) and the fault counters are
@@ -382,11 +403,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             obs.qc.uninstall()
             try:
                 # written even on a crashed run: the partial per-read
-                # records say exactly which reads' provenance completed
-                qc_agg = qc_recorder.aggregate()
-                qc_recorder.write_jsonl(qc_path, agg=qc_agg)
-                log.info("qc: %d per-read record(s) -> %s",
-                         len(qc_recorder.records), qc_path)
+                # records say exactly which reads' provenance completed.
+                # A --truth-only run has a recorder but no artifact path
+                # — report, don't write. A scored run already aggregated
+                # after the accuracy merge (the last mutation) — reuse
+                # it instead of rebuilding the histograms/funnel.
+                qc_agg = (qc_recorder.last_aggregate
+                          or qc_recorder.aggregate())
+                if qc_path:
+                    qc_recorder.write_jsonl(qc_path, agg=qc_agg)
+                    log.info("qc: %d per-read record(s) -> %s",
+                             len(qc_recorder.records), qc_path)
                 for ln in qc_recorder.report_lines(agg=qc_agg):
                     log.info("%s", ln)
             except OSError as e:
@@ -463,7 +490,7 @@ def _report_pending_leaks() -> None:
 
 
 def _run(args, argv, cfg, outdir: str, name: str, ckpt_dir: Optional[str],
-         mode_auto) -> int:
+         mode_auto, truth_path: Optional[str] = None) -> int:
     """The traced portion of a CLI invocation: input read → task run →
     output write, all inside the root ``run`` span."""
     with obs.span("run", cat="run"):
@@ -560,6 +587,37 @@ def _run(args, argv, cfg, outdir: str, name: str, ckpt_dir: Optional[str],
             with open(os.path.join(outdir, f"{name}.chim.tsv"), "w") as fh:
                 for rid, f0, t0, s in result.chimera:
                     fh.write(f"{rid}\t{f0}\t{t0}\t{s:.3f}\n")
+
+        # -- accuracy scoreboard (docs/OBSERVABILITY.md) -------------------
+        # host-only, after the device work: score every corrected read
+        # against its error-free source from the truth sidecar and merge
+        # the verdicts into the QC records/aggregate/gauges (truth_path
+        # comes from main() — the SAME value that decided the recorder
+        # install, so scoring can never run without a recorder)
+        if truth_path:
+            from proovread_tpu.obs import accuracy as obs_accuracy
+            with obs.span("score-accuracy", cat="host"):
+                truth_map, bp_map = obs_accuracy.load_truth_sidecar(
+                    truth_path)
+                qc_rec = obs.qc.current()
+                summary = obs_accuracy.apply_to_qc(
+                    qc_rec, longs, result.untrimmed, truth_map,
+                    truth_breakpoints=(bp_map if any(bp_map.values())
+                                       else None))
+                result.qc = qc_rec.aggregate()
+                qc_rec.last_aggregate = result.qc   # reused for the
+                #                                     artifact write
+                qc_rec.to_metrics(result.qc)
+            if summary["n_scored"]:
+                log.info(
+                    "accuracy: %d/%d read(s) scored vs truth — identity "
+                    "%.4f -> %.4f (%d classified)", summary["n_scored"],
+                    len(longs), summary["identity_before"],
+                    summary["identity_after"], summary["n_classified"])
+            else:
+                log.warning("accuracy: truth sidecar %s matched no "
+                            "corrected read ids — nothing scored",
+                            truth_path)
 
         for rep in result.reports:
             if rep.note:

@@ -60,11 +60,22 @@ def simulate_long_reads(
     qual: int = 10,
     seed: int = 1,
     id_prefix: str = "lr",
-) -> Tuple[List[SeqRecord], List[np.ndarray]]:
+    chimera_frac: float = 0.0,
+    with_breakpoints: bool = False,
+):
     """CLR-profile long reads totalling ~``total_bases``.
 
     Returns (records, truth) where truth[i] is the error-free source codes
-    of record i (oriented as the read), for identity scoring."""
+    of record i (oriented as the read), for identity scoring.
+
+    ``chimera_frac`` > 0 turns that fraction of reads into artificial
+    chimeras (a second, independently-located segment spliced on — the
+    library-prep artifact proovread's chimera detection hunts): the
+    read's truth becomes the concatenation and the junction coordinate
+    is recorded. All chimera draws come from a SEPARATE rng stream so
+    the default (chimera_frac=0) output stays byte-identical to earlier
+    rounds. ``with_breakpoints=True`` additionally returns the per-read
+    truth-junction list: (records, truth, breakpoints)."""
     rng = np.random.default_rng(seed)
     G = len(genome)
     lens, starts = [], []
@@ -79,17 +90,34 @@ def simulate_long_reads(
     srcs = [genome[s:s + ln] for s, ln in zip(starts, lens)]
     flat = np.concatenate(srcs)
     bounds = np.cumsum([0] + lens)
-    records, truth = [], []
+    rng_chim = np.random.default_rng(seed + 7919) if chimera_frac else None
+    records, truth, breakpoints = [], [], []
     for i, (s, ln) in enumerate(zip(starts, lens)):
         src = flat[bounds[i]:bounds[i + 1]]
         mut = _apply_errors(src, rng, sub, ins, dele)
         if rng.random() < 0.5:
             mut = revcomp_codes(mut)
             src = revcomp_codes(src)
+        bps: List[int] = []
+        if rng_chim is not None and rng_chim.random() < chimera_frac:
+            ln2 = int(np.clip(rng_chim.lognormal(np.log(mean_len), 0.55),
+                              min_len, G - 1))
+            s2 = int(rng_chim.integers(0, G - ln2))
+            src2 = genome[s2:s2 + ln2]
+            mut2 = _apply_errors(src2, rng_chim, sub, ins, dele)
+            if rng_chim.random() < 0.5:
+                mut2 = revcomp_codes(mut2)
+                src2 = revcomp_codes(src2)
+            bps = [len(mut)]               # junction, read coordinates
+            mut = np.concatenate([mut, mut2])
+            src = np.concatenate([src, src2])
         records.append(SeqRecord(
             f"{id_prefix}_{i}", decode_codes(mut),
             qual=np.full(len(mut), qual, np.uint8)))
         truth.append(src)
+        breakpoints.append(bps)
+    if with_breakpoints:
+        return records, truth, breakpoints
     return records, truth
 
 
@@ -229,7 +257,8 @@ def simulate_independent_segments(
     read_len: int = 300,
     sr_per: int = 6,
     lr_err: float = 0.08,
-) -> Tuple[List[SeqRecord], List[SeqRecord]]:
+    with_truth: bool = False,
+):
     """Long + short reads where every long read owns its own genome
     segment, so no short read can seed against more than one long read.
 
@@ -240,12 +269,17 @@ def simulate_independent_segments(
     sensitive — the documented deviation in tests/test_dmesh.py). The
     mesh-shape-invariance tests and ``make dmesh-smoke`` are built on it:
     byte-identical output across mesh 1/2/4 is only a meaningful assert
-    when the algorithm is exactly shard-invariant on the input."""
+    when the algorithm is exactly shard-invariant on the input.
+
+    ``with_truth=True`` additionally returns each long read's error-free
+    source segment (oriented as the read): ``(longs, srs, truths)`` —
+    the accuracy scoreboard's ground truth for the mesh runs."""
     rng = np.random.default_rng(seed)
-    longs, srs = [], []
+    longs, srs, truths = [], [], []
     si = 0
     for i in range(n_long):
         genome = rng.integers(0, 4, read_len).astype(np.int8)
+        truths.append(genome)
         noisy = []
         for base in genome:
             u = rng.random()
@@ -268,4 +302,55 @@ def simulate_independent_segments(
             srs.append(SeqRecord(f"s{si}", decode_codes(sseq),
                                  qual=np.full(100, 30, np.uint8)))
             si += 1
+    if with_truth:
+        return longs, srs, truths
     return longs, srs
+
+
+# --------------------------------------------------------------------------
+# truth sidecar (the accuracy scoreboard's ground-truth transport;
+# docs/OBSERVABILITY.md "Accuracy scoreboard")
+# --------------------------------------------------------------------------
+
+def fantasticus_truth(longs, orig_fq_path: str):
+    """id -> error-free source codes for the reference sample's
+    ``long_error`` reads (`long_error_N_M` pairs with `long_orig_N` by
+    the third id field). The ONE implementation of the sample's
+    id-pairing grammar — bench.py and obs/smoke.py both score through
+    it, so the mapping can't silently drift between them."""
+    from proovread_tpu.io import fastq
+    from proovread_tpu.ops.encode import encode_ascii
+    origs = {r.id.split("_")[2]: encode_ascii(r.seq)
+             for r in fastq.FastqReader(orig_fq_path)}
+    truth = {}
+    for rec in longs:
+        key = (rec.id.split("_")[2]
+               if rec.id.startswith("long_error_") else None)
+        if key and key in origs:
+            truth[rec.id] = origs[key]
+    return truth
+
+
+def write_truth_sidecar(path: str, records, truths,
+                        breakpoints=None) -> None:
+    """Emit the truth sidecar next to the simulated FASTQs: one JSONL
+    meta line (``{"truth_schema": 1, "n_reads": N}``) then one record
+    per read — id, the error-free source sequence oriented as the read,
+    and the true chimera-junction coordinates (empty list when the read
+    is not chimeric). This is what lets CLI *subprocess* runs be scored
+    (``--truth``, ``obs/accuracy.py``) — the simulator's in-memory truth
+    arrays survive the process boundary. Schema declared independently
+    in ``obs/validate.py:TRUTH_RECORD_FIELDS``; ``records`` may be
+    SeqRecords or bare id strings."""
+    import json
+    rows = []
+    for i, rec in enumerate(records):
+        bps = list(breakpoints[i]) if breakpoints is not None else []
+        rows.append({"id": str(getattr(rec, "id", rec)),
+                     "seq": decode_codes(np.asarray(truths[i], np.int8)),
+                     "breakpoints": [int(b) for b in bps]})
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"truth_schema": 1,
+                             "n_reads": len(rows)}) + "\n")
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")

@@ -14,6 +14,11 @@
   trajectories, support depth, corrected-base/phred-uplift counts,
   chimera/siamaera/trim funnel) serialized as ``--qc-out`` JSONL plus
   an aggregate QC report.
+- ``obs.accuracy`` — the accuracy scoreboard: ground-truth identity
+  scoring (batched bit-parallel LCS + banded error-class traceback)
+  against the simulators' truth sidecars (CLI ``--truth``), merged into
+  the QC records/aggregate and gated over ``ACCURACY_*.json`` history
+  (``make accuracy-check``).
 - ``obs.compilecache`` — the compile ledger: one strict-schema row per
   XLA compilation event (entry point, shape-signature, bucket,
   tracing/persistent cache hit-vs-miss) serialized as
@@ -27,7 +32,8 @@ CLI ``--trace`` / ``--metrics-out`` flags, the ``trace-file`` /
 ``obs.tracing()`` / ``obs.metrics.scope()``. See docs/OBSERVABILITY.md.
 """
 
-from proovread_tpu.obs import compilecache, memory, metrics, profile, qc
+from proovread_tpu.obs import (accuracy, compilecache, memory, metrics,
+                               profile, qc)
 from proovread_tpu.obs.profile import profiling
 from proovread_tpu.obs.trace import (NOOP_SPAN, Span, Tracer, count_retrace,
                                      enabled, span, tracing)
@@ -36,7 +42,8 @@ from proovread_tpu.obs.trace import install as install_tracer
 from proovread_tpu.obs.trace import uninstall as uninstall_tracer
 
 __all__ = [
-    "compilecache", "metrics", "memory", "profile", "qc", "profiling",
+    "accuracy", "compilecache", "metrics", "memory", "profile", "qc",
+    "profiling",
     "span", "Span",
     "Tracer",
     "tracing", "enabled", "count_retrace", "current_tracer",

@@ -81,7 +81,7 @@ def _log(msg: str) -> None:
 # -- workloads (bench.py's config ladder, rebuilt from the simulators) -----
 
 def _build_workload(config: int, cap_bases: Optional[int]):
-    """(longs, srs) for a prewarm-able bench config — 3 and 4 only (the
+    """(longs, srs, truths) for a prewarm-able bench config — 3 and 4 only (the
     simulated, self-contained ladder rungs; configs 1/2 differ only by
     iteration schedule, which the CLI runner cannot express, and need
     the reference sample). Generation parameters — genome size, total
@@ -101,21 +101,21 @@ def _build_workload(config: int, cap_bases: Optional[int]):
                                            simulate_short_reads)
     if config == 4:
         genome = random_genome(10_000, seed=0)
-        longs, _ = simulate_long_reads(genome, 40_000, seed=1)
+        longs, truths = simulate_long_reads(genome, 40_000, seed=1)
     elif config == 3:
         if cap_bases:
             # scaled slice (see docstring): genome cap/4, floored so the
             # lognormal length tail (N50 ~7 kb) is not squashed and the
             # Lp bucket ladder stays multi-stack
             genome = random_genome(max(cap_bases // 4, 21_000), seed=0)
-            longs, _ = simulate_long_reads(genome, cap_bases, seed=1)
+            longs, truths = simulate_long_reads(genome, cap_bases, seed=1)
         else:
             genome = random_genome(1_250_000, seed=0)
-            longs, _ = simulate_long_reads(genome, 5_000_000, seed=1)
+            longs, truths = simulate_long_reads(genome, 5_000_000, seed=1)
     else:
         raise ValueError(
             f"prewarm supports bench configs 3 and 4, not {config}")
-    return longs, simulate_short_reads(genome, 30.0, seed=2)
+    return longs, simulate_short_reads(genome, 30.0, seed=2), truths
 
 
 def _write_fastq(path: str, records) -> None:
@@ -169,7 +169,12 @@ def prewarm_config(config: int, cache_dir: str, *,
     if fresh and os.path.isdir(cache_dir):
         _log(f"config {config}: wiping cache dir {cache_dir} (--fresh)")
         shutil.rmtree(cache_dir)
-    longs, srs = _build_workload(config, cap_bases)
+    # truths discarded here: prewarm runs stay QC-off on purpose — the
+    # QC device reductions change program signatures, and the COMPILE
+    # rows must keep measuring the same zoo as the r09 baseline. The
+    # accuracy scoreboard scores this exact slice through its own scored
+    # run (obs/accuracy.py record, `make accuracy-record`).
+    longs, srs, _truths = _build_workload(config, cap_bases)
     total_bases = sum(len(r) for r in longs)
     _log(f"config {config}: {len(longs)} reads / {total_bases} bases"
          + (f" (cap {cap_bases})" if cap_bases else ""))

@@ -17,7 +17,13 @@ pipeline:
   deleted vs each pass's input) and phred-uplift count (columns whose
   called phred exceeds the input phred), accumulated over all passes,
 - chimera breakpoints (coordinates + scores), siamaera hits, CCS
-  provenance, and the trim/split funnel (pieces, bases lost per stage).
+  provenance, and the trim/split funnel (pieces, bases lost per stage),
+- ground-truth accuracy (``accuracy`` field, PR 10): when a truth
+  sidecar is supplied (CLI ``--truth``; ``obs/accuracy.py``), each
+  record carries identity_before/identity_after vs the error-free
+  source, the residual sub/ins/del class breakdown (remaining vs
+  introduced) on the classified sample, and chimera-detection
+  correctness vs the known truth breakpoints.
 
 **Zero overhead when off.** Like ``obs.metrics``, nothing records unless
 a :class:`QcRecorder` is installed (CLI ``--qc-out``, config ``qc-out``,
@@ -34,7 +40,7 @@ host-scan rungs and across ``--resume`` replays (the checkpoint journal
 persists each bucket's records; see ``pipeline/resilience.py``).
 
 Serialization (``--qc-out FILE``): JSONL — one meta line
-(``{"qc_schema": 1, "n_reads": N, "aggregate": {...}}``) followed by one
+(``{"qc_schema": 2, "n_reads": N, "aggregate": {...}}``) followed by one
 record object per read. The record schema is declared *independently* in
 ``obs/validate.py`` (``QC_RECORD_FIELDS``) and validated strictly — an
 undeclared field fails validation, so the writer can never silently
@@ -47,7 +53,11 @@ import json
 from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
-QC_SCHEMA_VERSION = 1
+# v2 (PR 10): the per-read ``accuracy`` field (ground-truth scoreboard)
+# joined the record schema — a breaking artifact change, versioned like
+# every schema here, so a pre-PR-10 artifact fails with a clean version
+# mismatch instead of a misleading missing-field error
+QC_SCHEMA_VERSION = 2
 
 # number of fixed-width bins in the aggregate histograms
 _N_BINS = 10
@@ -83,6 +93,8 @@ def new_record(read_id: str) -> Dict[str, Any]:
         "siamaera": None,          # {"action","start","len"} or None
         "ccs": None,               # {"role","n_subreads"} or None
         "trim": None,              # funnel: pieces / bases lost per stage
+        "accuracy": None,          # ground-truth scoreboard (--truth;
+        #                            obs/accuracy.py score_read_sets)
     }
 
 
@@ -97,6 +109,12 @@ class QcRecorder:
 
     def __init__(self):
         self.records: Dict[str, Dict[str, Any]] = {}
+        # optional aggregate cache a caller may set AFTER the run's last
+        # record mutation (cli.py stashes the post-scoring aggregate so
+        # the artifact write doesn't rebuild the histograms/funnel);
+        # aggregate() itself never auto-caches — records mutate freely
+        # during the run
+        self.last_aggregate: Optional[Dict[str, Any]] = None
 
     # -- record construction ---------------------------------------------
     def _rec(self, read_id: str) -> Dict[str, Any]:
@@ -187,6 +205,15 @@ class QcRecorder:
             "bases_out": int(bases_out),
         }
 
+    def record_accuracy(self, read_id: str,
+                        acc: Optional[Dict[str, Any]]) -> None:
+        """Attach one read's ground-truth accuracy verdict
+        (``obs/accuracy.py:score_read_sets`` record shape: identity
+        before/after, class breakdown, chimera correctness). Runs after
+        the pipeline, host-only — never on the device path."""
+        self._rec(read_id)["accuracy"] = (
+            None if acc is None else json.loads(json.dumps(acc)))
+
     # -- resilience integration ------------------------------------------
     def snapshot(self, read_ids: Sequence[str]) -> Dict[str, Any]:
         """Deep-copy the given reads' records for ladder rollback: a
@@ -273,6 +300,36 @@ class QcRecorder:
             "corrected_bases": sum(r["corrected_bases"] for r in recs),
             "phred_uplift": sum(r["phred_uplift"] for r in recs),
         }
+        # ground-truth accuracy section (obs/accuracy.py; only when a
+        # truth sidecar was scored — None otherwise, so unscored runs
+        # keep an explicit "not scored" marker instead of a silent gap)
+        scored = [r["accuracy"] for r in recs
+                  if r["accuracy"] is not None]
+        acc = None
+        if scored:
+            # class/chimera summation shared with the flat summary
+            # (obs/accuracy.py:class_totals) — one implementation, so
+            # ACCURACY rows and this aggregate can never drift
+            from proovread_tpu.obs.accuracy import (chimera_totals,
+                                                    class_totals)
+            classes = [a["classes"] for a in scored
+                       if a["classes"] is not None]
+            chim = [a["chimera"] for a in scored
+                    if a["chimera"] is not None]
+            acc = {
+                "n_scored": len(scored),
+                "n_classified": len(classes),
+                "identity_before": hist(
+                    [a["identity_before"] for a in scored],
+                    lo=0.0, hi=1.0),
+                "identity_after": hist(
+                    [a["identity_after"] for a in scored],
+                    lo=0.0, hi=1.0),
+                "errors_before": class_totals(classes, "before"),
+                "errors_after": class_totals(classes, "after"),
+                "introduced": class_totals(classes, "introduced"),
+                "chimera": chimera_totals(chim),
+            }
         return {
             "schema": QC_SCHEMA_VERSION,
             "n_reads": n,
@@ -284,6 +341,7 @@ class QcRecorder:
                                       if r["out_len"] > 0]),
             },
             "funnel": funnel,
+            "accuracy": acc,
         }
 
     def to_metrics(self, agg: Optional[Dict[str, Any]] = None) -> None:
@@ -303,6 +361,16 @@ class QcRecorder:
             agg["histograms"]["masked_frac_final"]["mean"])
         g("qc_mean_support_mean", unit="x").set(
             agg["histograms"]["mean_support"]["mean"])
+        acc = agg.get("accuracy")
+        if acc:
+            g("accuracy_reads_scored", unit="reads").set(
+                acc["n_scored"])
+            g("accuracy_identity_before_mean", unit="frac").set(
+                acc["identity_before"]["mean"])
+            g("accuracy_identity_after_mean", unit="frac").set(
+                acc["identity_after"]["mean"])
+            g("accuracy_errors_introduced_total", unit="errors").set(
+                sum((acc["introduced"] or {}).values()))
 
     # -- serialization ----------------------------------------------------
     def iter_records(self) -> List[Dict[str, Any]]:
@@ -342,6 +410,18 @@ class QcRecorder:
             f"qc: corrections — {f['corrected_bases']} base edit(s), "
             f"{f['phred_uplift']} phred-uplifted column(s)",
         ]
+        acc = agg.get("accuracy")
+        if acc:
+            intro = sum((acc["introduced"] or {}).values()) \
+                if acc["introduced"] is not None else None
+            lines.append(
+                f"qc: accuracy — {acc['n_scored']} read(s) scored vs "
+                f"truth, identity "
+                f"{acc['identity_before']['mean']:.4f} -> "
+                f"{acc['identity_after']['mean']:.4f}"
+                + (f"; {intro} error(s) introduced over "
+                   f"{acc['n_classified']} classified read(s)"
+                   if intro is not None else ""))
         for name, h in agg["histograms"].items():
             if not h["counts"]:
                 continue

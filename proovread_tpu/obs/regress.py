@@ -49,6 +49,11 @@ PHASE_THRESHOLD = 0.30
 # ... but only when the absolute growth also exceeds this (a 10 ms phase
 # doubling is measurement noise, not a regression)
 MIN_ABS_S = 0.5
+# identity_after may drop by this much (ABSOLUTE identity points) vs the
+# rolling-baseline median — the accuracy scoreboard's no-regression delta
+# on BENCH rows (obs/accuracy.py gates the dedicated ACCURACY history;
+# this check keeps the bench's own identity trajectory honest too)
+IDENTITY_DROP = 0.005
 # rolling baseline: median over up to this many prior usable rows
 BASELINE_WINDOW = 3
 
@@ -113,6 +118,7 @@ def perf_check(entries: List[Dict[str, Any]],
                value_threshold: float = VALUE_THRESHOLD,
                phase_threshold: float = PHASE_THRESHOLD,
                min_abs_s: float = MIN_ABS_S,
+               identity_drop: float = IDENTITY_DROP,
                window: int = BASELINE_WINDOW) -> Dict[str, Any]:
     """The gate, as data. Returns ``{"schema", "verdict", "latest",
     "baseline_rounds", "checks": [...]}`` with verdict PASS / REGRESSION /
@@ -177,6 +183,51 @@ def perf_check(entries: List[Dict[str, Any]],
             "wall_s", float(lrow["wall_s"]), _median(walls),
             higher_is_better=False, threshold=value_threshold,
             min_abs=min_abs_s))
+
+    # correction accuracy (higher is better; VERDICT finding 3): BENCH
+    # rows r01-r07 predate the accuracy-scoreboard fields, and a row may
+    # carry explicit nulls when scoring itself was skipped — both pool
+    # NON-fatally (.get() throughout, never a KeyError): absence is a
+    # "skipped"/"missing" item, only a measured drop regresses. Only
+    # rows that carry the scoreboard's "accuracy" detail dict baseline:
+    # pre-PR10 identity_after came from the deleted quadratic SW sampler
+    # (<=4 kb reads, <=64 sampled) — a different, easier statistic that
+    # must not gate the every-read LCS numbers under a 0.005 threshold.
+    base_idents = [float(e["row"]["identity_after"]) for e in pool
+                   if isinstance(e["row"].get("identity_after"),
+                                 (int, float))
+                   and isinstance(e["row"].get("accuracy"), dict)]
+    legacy_idents = not base_idents and any(
+        isinstance(e["row"].get("identity_after"), (int, float))
+        for e in pool)
+    lident = lrow.get("identity_after")
+    if legacy_idents and isinstance(lident, (int, float)):
+        checks.append({"check": "identity_after", "status": "skipped",
+                       "note": "baseline identity_after predates the "
+                               "accuracy scoreboard (bounded SW sample) "
+                               "— methodologies are not comparable"})
+    elif base_idents:
+        if isinstance(lident, (int, float)):
+            med = _median(base_idents)
+            checks.append({
+                "check": "identity_after",
+                "status": ("regressed"
+                           if float(lident) < med - identity_drop
+                           else "ok"),
+                "value": round(float(lident), 4),
+                "baseline": round(med, 4),
+                "threshold": identity_drop})
+        else:
+            note = ("baseline rows carry identity_after, latest row "
+                    "has none")
+            if lrow.get("accuracy_skipped"):
+                note += f" (accuracy_skipped: {lrow['accuracy_skipped']})"
+            checks.append({"check": "identity_after",
+                           "status": "missing", "note": note})
+    elif isinstance(lident, (int, float)):
+        checks.append({"check": "identity_after", "status": "skipped",
+                       "note": "no baseline rows carry identity_after "
+                               "yet (pre-scoreboard history)"})
 
     # per-phase wall (lower is better): phases the baseline knows about
     base_phases: Dict[str, List[float]] = {}
@@ -317,6 +368,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     chk.add_argument("--min-abs-s", type=float, default=MIN_ABS_S,
                      help="minimum absolute seconds of growth to count "
                           f"(default {MIN_ABS_S})")
+    chk.add_argument("--identity-drop", type=float, default=IDENTITY_DROP,
+                     help="allowed absolute identity_after drop vs the "
+                          f"rolling baseline (default {IDENTITY_DROP})")
     chk.add_argument("--window", type=int, default=BASELINE_WINDOW,
                      help="rolling-baseline row count "
                           f"(default {BASELINE_WINDOW})")
@@ -335,13 +389,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          value_threshold=args.value_threshold,
                          phase_threshold=args.phase_threshold,
                          min_abs_s=args.min_abs_s,
+                         identity_drop=args.identity_drop,
                          window=args.window)
     for c in verdict["checks"]:
         if c["status"] == "regressed":
+            detail = (f"({c['delta_frac']:+.1%}, threshold "
+                      f"{c['threshold']:.0%})" if "delta_frac" in c
+                      else f"(threshold {c['threshold']} absolute)")
             print(f"PERF-REGRESSION: {c['check']} = {c['value']} vs "
-                  f"baseline {c['baseline']} "
-                  f"({c['delta_frac']:+.1%}, threshold "
-                  f"{c['threshold']:.0%})", file=sys.stderr)
+                  f"baseline {c['baseline']} {detail}", file=sys.stderr)
         elif c["status"] == "missing":
             print(f"perf-check: missing — {c.get('note', c)}",
                   file=sys.stderr)

@@ -55,10 +55,16 @@ def _write_fastq(path: str, records) -> None:
 
 
 def _workload(tmp: str):
-    """(long_fq, short_fq) paths; tiny but multi-bucket."""
-    from proovread_tpu.io.simulate import (random_genome,
+    """(long_fq, short_fq, truth_sidecar_or_None) paths; tiny but
+    multi-bucket. Both branches know each read's error-free source, so
+    the smoke also exercises the accuracy scoreboard end-to-end
+    (sidecar -> CLI --truth -> scored QC artifact)."""
+    from proovread_tpu.io.simulate import (fantasticus_truth,
+                                           random_genome,
                                            simulate_long_reads,
-                                           simulate_short_reads)
+                                           simulate_short_reads,
+                                           write_truth_sidecar)
+    tp = os.path.join(tmp, "truth.jsonl")
     if os.path.isdir(_SAMPLE):
         from proovread_tpu.io import fasta, fastq
         from proovread_tpu.ops.encode import encode_ascii
@@ -66,12 +72,20 @@ def _workload(tmp: str):
             f"{_SAMPLE}/F.antasticus_genome.fa"))).seq)
         longs = list(fastq.FastqReader(
             f"{_SAMPLE}/F.antasticus_long_error.fq"))[:24]
-        _log(f"sample workload: {len(longs)} F.antasticus reads")
+        truth = fantasticus_truth(
+            longs, f"{_SAMPLE}/F.antasticus_long_orig.fq")
+        if truth:
+            write_truth_sidecar(tp, list(truth), list(truth.values()))
+        else:
+            tp = None
+        _log(f"sample workload: {len(longs)} F.antasticus reads "
+             f"({len(truth)} with truth)")
     else:
         genome = random_genome(3000, seed=5)
-        longs, _truth = simulate_long_reads(
+        longs, truths = simulate_long_reads(
             genome, total_bases=5000, mean_len=700, min_len=400,
             seed=6)
+        write_truth_sidecar(tp, longs, truths)
         _log(f"synthetic workload: {len(longs)} simulated reads "
              "(reference sample absent)")
     srs = simulate_short_reads(genome, 30.0, seed=7)
@@ -79,14 +93,17 @@ def _workload(tmp: str):
     sp = os.path.join(tmp, "short.fq")
     _write_fastq(lp, longs)
     _write_fastq(sp, srs)
-    return lp, sp
+    return lp, sp, tp
 
 
-def _validate_qc_artifact(qcp: str, trace: str = None) -> bool:
+def _validate_qc_artifact(qcp: str, trace: str = None,
+                          scored: bool = False) -> bool:
     """Validate the --qc-out artifact: strict per-record schema, at least
     one record, every record finished (out_len > 0, trajectory present),
     and — when a trace was written — every non-null bucket_span resolves
-    to a bucket span id actually present in the trace."""
+    to a bucket span id actually present in the trace. ``scored``: the
+    run carried a truth sidecar, so the aggregate must hold an accuracy
+    section with at least one scored read and uplifted identity."""
     from proovread_tpu.obs.validate import ValidationError, validate_qc
 
     try:
@@ -94,6 +111,20 @@ def _validate_qc_artifact(qcp: str, trace: str = None) -> bool:
     except ValidationError as e:
         _log(f"FAILED: {e}")
         return False
+    if scored:
+        acc = (qstats["aggregate"] or {}).get("accuracy")
+        if not acc or acc.get("n_scored", 0) < 1:
+            _log("FAILED: --truth run but the QC aggregate carries no "
+                 "accuracy section")
+            return False
+        idb = acc["identity_before"]["mean"]
+        ida = acc["identity_after"]["mean"]
+        if ida < idb:
+            _log(f"FAILED: correction lowered identity "
+                 f"({idb:.4f} -> {ida:.4f})")
+            return False
+        _log(f"accuracy OK: {acc['n_scored']} read(s) scored, identity "
+             f"{idb:.4f} -> {ida:.4f}")
     unfinished = 0
     span_ids = set()
     if trace is not None:
@@ -131,7 +162,7 @@ def main(argv=None) -> int:
     qc_only = "--qc-only" in argv
 
     with tempfile.TemporaryDirectory(prefix="proovread_smoke_") as tmp:
-        lp, sp = _workload(tmp)
+        lp, sp, tp = _workload(tmp)
         cfgp = os.path.join(tmp, "smoke.cfg")
         with open(cfgp, "w") as fh:
             json.dump({"batch-reads": 8, "device-chunk": 128,
@@ -143,8 +174,11 @@ def main(argv=None) -> int:
         ledp = os.path.join(tmp, "run.ledger.jsonl")
         cli_args = ["-l", lp, "-s", sp, "-p", out, "-m", "sr-noccs",
                     "-c", cfgp, "--qc-out", qcp]
+        if tp:
+            cli_args += ["--truth", tp]
         if qc_only:
-            _log("running CLI with --qc-out (qc-smoke)")
+            _log("running CLI with --qc-out"
+                 + (" + --truth" if tp else "") + " (qc-smoke)")
         else:
             _log("running CLI with --trace/--metrics-out/--qc-out/"
                  "--compile-ledger (+ leak check)")
@@ -158,7 +192,7 @@ def main(argv=None) -> int:
             return 1
         lrep = leak.report()
         if qc_only:
-            if not _validate_qc_artifact(qcp):
+            if not _validate_qc_artifact(qcp, scored=bool(tp)):
                 return 1
             _log("PASS")
             return 0
@@ -176,7 +210,7 @@ def main(argv=None) -> int:
             _log("FAILED: bucket spans carry zero total cost attribution "
                  f"({json.dumps(tstats)}) — the profiler did not run")
             return 1
-        if not _validate_qc_artifact(qcp, trace=trace):
+        if not _validate_qc_artifact(qcp, trace=trace, scored=bool(tp)):
             return 1
         # compile ledger: strict schema + the ledger<->span-tree
         # reconciliation (rows and the trace's compile split are fed by

@@ -45,7 +45,9 @@ _REQUIRED_X = ("name", "cat", "ph", "ts", "dur", "pid", "tid", "args")
 # of accepted JSON-decoded types.
 _NUM = (int, float)
 _OPT_INT = (int, type(None))
-QC_SCHEMA_VERSION = 1
+# v2 (PR 10): + the ground-truth "accuracy" field (obs/accuracy.py) — a
+# breaking record-schema change, versioned like the SLO v2 bump
+QC_SCHEMA_VERSION = 2
 QC_RECORD_FIELDS = {
     "id": (str,),
     "bucket": _OPT_INT,            # length-bucket ordinal
@@ -62,6 +64,7 @@ QC_RECORD_FIELDS = {
     "siamaera": (dict, type(None)),
     "ccs": (dict, type(None)),
     "trim": (dict, type(None)),
+    "accuracy": (dict, type(None)),  # ground-truth scoreboard (--truth)
 }
 # nested-object schemas, same strictness
 QC_SIAMAERA_FIELDS = {"action": (str,), "start": (int,), "len": (int,)}
@@ -69,6 +72,28 @@ QC_CCS_FIELDS = {"role": (str,), "n_subreads": (int,)}
 QC_TRIM_FIELDS = {"pieces": (int,), "chimera_bases_lost": (int,),
                   "trim_bases_lost": (int,), "pieces_dropped": (int,),
                   "bases_out": (int,)}
+# ground-truth accuracy verdict (obs/accuracy.py:score_read_sets):
+# identity for every scored read; "classes" only on the classified
+# sample; "chimera" only when the truth sidecar carried breakpoints
+QC_ACCURACY_FIELDS = {"identity_before": _NUM, "identity_after": _NUM,
+                      "lcs_before": (int,), "lcs_after": (int,),
+                      "truth_len": (int,),
+                      "classes": (dict, type(None)),
+                      "chimera": (dict, type(None))}
+QC_ACCURACY_CLASS_FIELDS = {
+    f"{k}_{stage}": (int,)
+    for k in ("sub", "ins", "del")
+    for stage in ("before", "after", "introduced")}
+QC_ACCURACY_CHIMERA_FIELDS = {"truth": (int,), "detected": (int,),
+                              "matched": (int,)}
+
+# -- truth-sidecar schema (io/simulate.py:write_truth_sidecar writer) ------
+# Same declaration discipline: the sidecar the simulators emit (and the
+# CLI --truth flag consumes, obs/accuracy.py:load_truth_sidecar) is
+# declared here and validated strictly.
+TRUTH_SCHEMA_VERSION = 1
+TRUTH_RECORD_FIELDS = {"id": (str,), "seq": (str,),
+                       "breakpoints": (list,)}
 
 
 # -- mesh fault-domain metrics schema (pipeline/driver.py writer) ----------
@@ -498,6 +523,28 @@ def validate_qc_record(rec: Dict[str, Any], where: str = "record") -> None:
             if not isinstance(sub[k], types):
                 _fail(f"{where}: {key}.{k} has type "
                       f"{type(sub[k]).__name__}")
+    acc = rec["accuracy"]
+    if acc is not None:
+        for nest, schema, sub in (
+                ("accuracy", QC_ACCURACY_FIELDS, acc),
+                ("accuracy.classes", QC_ACCURACY_CLASS_FIELDS,
+                 acc.get("classes")),
+                ("accuracy.chimera", QC_ACCURACY_CHIMERA_FIELDS,
+                 acc.get("chimera"))):
+            if sub is None:
+                continue
+            sub_missing = [k for k in schema if k not in sub]
+            sub_unknown = [k for k in sub if k not in schema]
+            if sub_missing or sub_unknown:
+                _fail(f"{where}: {nest} object missing {sub_missing} / "
+                      f"undeclared {sub_unknown}")
+            for k, types in schema.items():
+                if not isinstance(sub[k], types):
+                    _fail(f"{where}: {nest}.{k} has type "
+                          f"{type(sub[k]).__name__}")
+        for k in ("identity_before", "identity_after"):
+            if not 0.0 <= acc[k] <= 1.0:
+                _fail(f"{where}: accuracy.{k} {acc[k]!r} not in [0, 1]")
 
 
 def validate_qc(path: str, min_reads: int = 0) -> Dict[str, Any]:
@@ -543,6 +590,73 @@ def validate_qc(path: str, min_reads: int = 0) -> Dict[str, Any]:
         _fail(f"{path}: {n} record(s) < required {min_reads}")
     return {"n_records": n, "n_chimeric": n_chimeric,
             "aggregate": meta["aggregate"]}
+
+
+def validate_truth_sidecar(path: str, min_reads: int = 0
+                           ) -> Dict[str, Any]:
+    """Strictly validate a truth sidecar (``io/simulate.py:
+    write_truth_sidecar`` -> CLI ``--truth``): one meta line (schema
+    version + read count) then one record per read — id, the error-free
+    source sequence (ACGTN alphabet), and the true chimera-junction
+    coordinates (possibly empty, always present). Returns summary
+    stats."""
+    n = 0
+    n_bases = 0
+    n_chimeric = 0
+    ids = set()
+    meta = None
+    allowed = set("ACGTN-")
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                _fail(f"{path}:{lineno}: not JSON ({e})")
+            if lineno == 1:
+                if not isinstance(obj, dict) or \
+                        obj.get("truth_schema") != TRUTH_SCHEMA_VERSION:
+                    _fail(f"{path}: first line must be the meta record "
+                          f"with truth_schema == {TRUTH_SCHEMA_VERSION}")
+                meta = obj
+                continue
+            missing = [k for k in TRUTH_RECORD_FIELDS if k not in obj]
+            unknown = [k for k in obj if k not in TRUTH_RECORD_FIELDS]
+            if missing or unknown:
+                _fail(f"{path}:{lineno}: missing {missing} / undeclared "
+                      f"{unknown} — declare in obs/validate.py:"
+                      "TRUTH_RECORD_FIELDS first")
+            for k, types in TRUTH_RECORD_FIELDS.items():
+                if not isinstance(obj[k], types):
+                    _fail(f"{path}:{lineno}: field {k!r} has type "
+                          f"{type(obj[k]).__name__}")
+            if obj["id"] in ids:
+                _fail(f"{path}:{lineno}: duplicate read id "
+                      f"{obj['id']!r}")
+            ids.add(obj["id"])
+            bad = set(obj["seq"]) - allowed
+            if bad:
+                _fail(f"{path}:{lineno}: seq contains non-ACGTN "
+                      f"characters {sorted(bad)}")
+            for b in obj["breakpoints"]:
+                if not isinstance(b, int) or not 0 <= b <= len(obj["seq"]):
+                    _fail(f"{path}:{lineno}: breakpoint {b!r} outside "
+                          f"[0, {len(obj['seq'])}]")
+            n += 1
+            n_bases += len(obj["seq"])
+            if obj["breakpoints"]:
+                n_chimeric += 1
+    if meta is None:
+        _fail(f"{path}: empty truth sidecar (no meta line)")
+    if meta.get("n_reads") != n:
+        _fail(f"{path}: meta n_reads {meta.get('n_reads')} != "
+              f"{n} record line(s)")
+    if n < min_reads:
+        _fail(f"{path}: {n} record(s) < required {min_reads}")
+    return {"n_records": n, "n_bases": n_bases,
+            "n_chimeric": n_chimeric}
 
 
 def validate_slo(path: str, require_drained: bool = False
@@ -666,6 +780,9 @@ def main(argv=None) -> int:
                          "backend-compile ms reconcile with the span "
                          "tree's compile split")
     ap.add_argument("--slo", help="serving SLO artifact (serve --slo-out)")
+    ap.add_argument("--truth-sidecar", dest="truth_sidecar",
+                    help="truth sidecar JSONL (io/simulate.py writer; "
+                         "the CLI --truth input)")
     ap.add_argument("--require-drained", action="store_true",
                     help="SLO artifact must show a clean drain")
     ap.add_argument("--min-qc-reads", type=int, default=0,
@@ -679,9 +796,9 @@ def main(argv=None) -> int:
                     help="comma-separated counter names that must exist")
     args = ap.parse_args(argv)
     if not (args.trace or args.metrics or args.qc or args.slo
-            or args.compile_ledger):
-        ap.error("need --trace, --metrics, --qc, --compile-ledger "
-                 "and/or --slo")
+            or args.compile_ledger or args.truth_sidecar):
+        ap.error("need --trace, --metrics, --qc, --compile-ledger, "
+                 "--truth-sidecar and/or --slo")
     try:
         if args.trace:
             stats = validate_trace(
@@ -705,6 +822,9 @@ def main(argv=None) -> int:
                 rstats = reconcile_compile_ledger(args.compile_ledger,
                                                   args.trace)
                 print(f"compile-ledger reconciles: {json.dumps(rstats)}")
+        if args.truth_sidecar:
+            stats = validate_truth_sidecar(args.truth_sidecar)
+            print(f"truth-sidecar OK: {json.dumps(stats)}")
         if args.slo:
             stats = validate_slo(args.slo,
                                  require_drained=args.require_drained)

@@ -7,8 +7,13 @@ simulate_independent_segments`` — every long read owns its genome segment,
 so sharded execution is exact, and "byte-identical" is a meaningful
 assert):
 
-1. **baseline** — single-device run, QC on: the reference ``--qc-out``
-   aggregate every later phase must reproduce byte-for-byte;
+1. **baseline** — single-device run, QC on and scored against the
+   workload's ground truth (``obs/accuracy.py``; the simulator knows
+   every read's error-free source): the reference ``--qc-out``
+   aggregate — including the identity_before/identity_after verdicts —
+   every later phase must reproduce byte-for-byte, so mesh faults,
+   shrunken-mesh recovery and cross-shape resume provably cannot move
+   the accuracy numbers;
 2. **headline** — ``device_lost@d1.p2``: shard 1's chip dies at iteration
    2 of the 4-way mesh; the run must complete via the shrunken-mesh rung
    (``mesh-dp3``), with the demotion attributed to shard 1 in the
@@ -65,9 +70,15 @@ def _log(msg: str) -> None:
 
 
 def _workload():
+    """(longs, srs, truth_map) — the shard-exact workload plus each
+    read's error-free source for the accuracy scoreboard (every run in
+    this smoke is scored, so the byte-compares also pin the identity
+    numbers as mesh-shape-invariant)."""
     from proovread_tpu.io.simulate import simulate_independent_segments
-    return simulate_independent_segments(
-        seed=SEED, n_long=N_LONG, read_len=READ_LEN, sr_per=SR_PER)
+    longs, srs, truths = simulate_independent_segments(
+        seed=SEED, n_long=N_LONG, read_len=READ_LEN, sr_per=SR_PER,
+        with_truth=True)
+    return longs, srs, {r.id: t for r, t in zip(longs, truths)}
 
 
 def _pcfg(**kw):
@@ -81,9 +92,12 @@ def _pcfg(**kw):
     return PipelineConfig(**cfg)
 
 
-def _run(longs, srs, bucket_done=None, **kw):
+def _run(longs, srs, truth=None, bucket_done=None, **kw):
     """One pipeline run under a QC scope; returns (qc aggregate JSON
-    bytes, per-read record dict, PipelineResult)."""
+    bytes, per-read record dict, PipelineResult). With ``truth`` the
+    run is scored against ground truth (obs/accuracy.py) before the
+    aggregate snapshots, so the byte-compares cover the accuracy
+    verdicts too — identity must be mesh-shape-invariant."""
     from proovread_tpu import obs
     from proovread_tpu.pipeline.driver import Pipeline
     pipe = Pipeline(_pcfg(**kw))
@@ -91,6 +105,8 @@ def _run(longs, srs, bucket_done=None, **kw):
         pipe._bucket_done = bucket_done
     with obs.qc.scope() as rec:
         res = pipe.run(longs, srs)
+        if truth is not None:
+            obs.accuracy.apply_to_qc(rec, longs, res.untrimmed, truth)
         agg = json.dumps(rec.aggregate(), sort_keys=True).encode()
         recs = {r["id"]: r for r in rec.iter_records()}
     return agg, recs, res
@@ -105,13 +121,13 @@ def _child(ckpt_dir: str) -> int:
     """Phase-4 child: mesh=4 run with the journal, real SIGTERM to self
     right after bucket 0 completes (journal.put precedes _bucket_done, so
     the entry is on disk when the signal lands)."""
-    longs, srs = _workload()
+    longs, srs, truth = _workload()
 
     def die_after_first(gi, results, chim, replayed):
         if gi == 0:
             os.kill(os.getpid(), signal.SIGTERM)
 
-    _run(longs, srs, bucket_done=die_after_first,
+    _run(longs, srs, truth, bucket_done=die_after_first,
          mesh_shards=4, checkpoint_dir=ckpt_dir)
     _log("child: ran to completion — SIGTERM never fired?")
     return 1
@@ -149,7 +165,7 @@ def main(argv=None) -> int:
         _log(f"FAILED: need 4 simulated devices, have {jax.device_count()}")
         return 1
     leak = LeakCheck()
-    longs, srs = _workload()
+    longs, srs, truth = _workload()
     _log(f"workload: {len(longs)} long reads (disjoint segments), "
          f"{len(srs)} short reads, 2 length buckets")
 
@@ -157,9 +173,22 @@ def main(argv=None) -> int:
     # UNtraced: the QC records the later byte-compares anchor on carry
     # bucket_span ids only under tracing, so the reference run must stay
     # exactly as instrumented as the faulted runs it is compared against
-    agg0, recs0, res0 = _run(longs, srs)
+    agg0, recs0, res0 = _run(longs, srs, truth)
+    acc0 = (json.loads(agg0).get("accuracy") or {})
+    if acc0.get("n_scored") != len(longs):
+        _log(f"FAILED: baseline scored {acc0.get('n_scored')} of "
+             f"{len(longs)} reads against truth")
+        return 1
+    idb = acc0["identity_before"]["mean"]
+    ida = acc0["identity_after"]["mean"]
+    if ida < idb:
+        _log(f"FAILED: correction lowered identity "
+             f"({idb:.4f} -> {ida:.4f})")
+        return 1
     _log(f"baseline: {len(recs0)} QC records, "
-         f"aggregate {len(agg0)} bytes")
+         f"aggregate {len(agg0)} bytes, identity {idb:.4f} -> "
+         f"{ida:.4f} (every later byte-compare pins these as "
+         "mesh-shape-invariant)")
 
     # -- phase 1b: traced + compile-ledgered rerun ------------------------
     # the mesh-tier check that ledger rows reconcile with the span
@@ -172,7 +201,7 @@ def main(argv=None) -> int:
     from proovread_tpu.obs.validate import (reconcile_compile_ledger,
                                             validate_compile_ledger)
     with obs.tracing() as tr0, obs_cc.scope() as led0:
-        _, _, res0b = _run(longs, srs)
+        _, _, res0b = _run(longs, srs, truth)
     with _tf.TemporaryDirectory(prefix="proovread_dmesh_led_") as ltmp:
         tracep = os.path.join(ltmp, "t.jsonl")
         ledp = os.path.join(ltmp, "l.jsonl")
@@ -197,7 +226,7 @@ def main(argv=None) -> int:
     # ledger on: the mesh path's programs must enter the census through
     # the dmesh compile chokepoint (every sharded step is a dmesh: entry)
     with obs_cc.scope() as led1:
-        agg1, recs1, res1 = _run(longs, srs, mesh_shards=4,
+        agg1, recs1, res1 = _run(longs, srs, truth, mesh_shards=4,
                                  fault_spec=HEADLINE_FAULT)
     if not any(e.startswith("dmesh:")
                for e in led1.census()["by_entry"]):
@@ -230,7 +259,7 @@ def main(argv=None) -> int:
                                    ("collective_timeout@d0.p1x1",
                                     "fused", "0")):
         kind = spec.split("@")[0]
-        agg_k, recs_k, res_k = _run(longs, srs, mesh_shards=4,
+        agg_k, recs_k, res_k = _run(longs, srs, truth, mesh_shards=4,
                                     fault_spec=spec)
         demotes = [r.note for r in res_k.reports
                    if r.task.startswith("demote")]
@@ -265,7 +294,7 @@ def main(argv=None) -> int:
             return 1
         _log(f"child SIGTERM'd with {n_journaled} bucket(s) journaled; "
              "resuming at mesh=2")
-        agg2, recs2, res2 = _run(longs, srs, mesh_shards=2,
+        agg2, recs2, res2 = _run(longs, srs, truth, mesh_shards=2,
                                  checkpoint_dir=ckpt, resume=True)
         replays = sum(_counter(res2, "checkpoint_journal_replays")
                       .values())

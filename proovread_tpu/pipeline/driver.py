@@ -266,6 +266,18 @@ def _declare_metrics(reg) -> None:
               "mean final HCR-masked fraction across reads")
     reg.gauge("qc_mean_support_mean", "x",
               "mean finish-pass support depth across reads")
+    # ground-truth accuracy gauges (obs/accuracy.py): pre-declared so an
+    # unscored run still exposes the schema (zero-valued) — set only
+    # when a truth sidecar is scored (CLI --truth)
+    reg.gauge("accuracy_reads_scored", "reads",
+              "reads scored against a ground-truth sidecar")
+    reg.gauge("accuracy_identity_before_mean", "frac",
+              "mean input-read identity vs truth (LCS/max-len)")
+    reg.gauge("accuracy_identity_after_mean", "frac",
+              "mean corrected-read identity vs truth (LCS/max-len)")
+    reg.gauge("accuracy_errors_introduced_total", "errors",
+              "sub+ins+del errors introduced by correction "
+              "(classified sample)")
 
 
 def _align_params(mode: str, iteration: Optional[int]) -> AlignParams:

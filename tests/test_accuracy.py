@@ -1,0 +1,591 @@
+"""Accuracy scoreboard (obs/accuracy.py, docs/OBSERVABILITY.md).
+
+Layers under test:
+
+- the batched bit-parallel LCS and the banded edit-class traceback,
+  golden-tested against naive O(n*m) reference DPs (multiword carry
+  chains crossed on purpose: lengths straddling 64/128-bit boundaries);
+- falsifiability: an injected miscorrection (flipped bases in the
+  corrected output) must measurably lower scored identity, surface as
+  introduced substitutions, and trip the ``make accuracy-check`` gate
+  with rc 1 — BEFORE any real history exists, via the floor and uplift
+  checks;
+- the truth-sidecar round trip: simulate -> write sidecar -> real CLI
+  run with ``--truth`` -> strictly-validated scored QC artifact;
+- the tier-1 zero-overhead-when-off guard (QC/ledger pattern): no
+  scoring machinery may run without a truth sidecar;
+- gate verdict units incl. (config, backend, mesh) pool isolation and
+  non-fatal tolerance for rows whose scoring was skipped.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from proovread_tpu.obs import accuracy
+from proovread_tpu.obs import qc as obs_qc
+from proovread_tpu.obs import validate as obs_validate
+from proovread_tpu.obs.validate import (ValidationError,
+                                        validate_qc, validate_qc_record,
+                                        validate_truth_sidecar)
+
+
+# --------------------------------------------------------------------------
+# reference DPs (naive, quadratic — the oracles)
+# --------------------------------------------------------------------------
+
+def _ref_lcs(a, b):
+    la, lb = len(a), len(b)
+    prev = np.zeros(lb + 1, np.int32)
+    for i in range(1, la + 1):
+        cur = np.zeros(lb + 1, np.int32)
+        for j in range(1, lb + 1):
+            m = 1 if (a[i - 1] == b[j - 1] and a[i - 1] < 4) else 0
+            cur[j] = max(prev[j], cur[j - 1], prev[j - 1] + m)
+        prev = cur
+    return int(prev[lb])
+
+
+def _ref_edit(a, b):
+    la, lb = len(a), len(b)
+    prev = np.arange(lb + 1, dtype=np.int32)
+    for i in range(1, la + 1):
+        cur = np.zeros(lb + 1, np.int32)
+        cur[0] = i
+        for j in range(1, lb + 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (a[i - 1] != b[j - 1]))
+        prev = cur
+    return int(prev[lb])
+
+
+class TestLcs:
+    def test_matches_reference_dp(self):
+        rng = np.random.default_rng(0)
+        pairs, refs = [], []
+        for _ in range(40):
+            la = int(rng.integers(0, 180))
+            lb = int(rng.integers(0, 180))
+            a = rng.integers(0, 5, la).astype(np.int8)   # incl. N codes
+            b = rng.integers(0, 4, lb).astype(np.int8)
+            pairs.append((a, b))
+            refs.append(_ref_lcs(a, b))
+        got = accuracy.lcs_lengths(pairs)
+        assert list(got) == refs
+
+    def test_word_boundary_lengths(self):
+        """Multiword carry chains: pattern lengths straddling the 64-bit
+        word boundary, plus an identical pair (all-ones propagate runs —
+        the Kogge-Stone carry scan's worst case)."""
+        rng = np.random.default_rng(1)
+        pairs, refs = [], []
+        for m in (63, 64, 65, 127, 128, 129, 200):
+            b = rng.integers(0, 4, m).astype(np.int8)
+            a = b.copy()
+            mut = rng.random(m) < 0.25
+            a[mut] = (a[mut] + 1) % 4
+            pairs.append((a, b))
+            refs.append(_ref_lcs(a, b))
+        ident = rng.integers(0, 4, 150).astype(np.int8)
+        pairs.append((ident.copy(), ident))
+        refs.append(150)
+        assert list(accuracy.lcs_lengths(pairs)) == refs
+
+    def test_empty_and_n_only(self):
+        e = np.zeros(0, np.int8)
+        n4 = np.full(10, 4, np.int8)
+        b = np.arange(4, dtype=np.int8)
+        got = accuracy.lcs_lengths([(e, b), (b, e), (n4, n4), (b, b)])
+        assert list(got) == [0, 0, 0, 4]
+
+
+class TestEditAlignment:
+    def test_matches_reference_distance_and_classes_are_consistent(self):
+        rng = np.random.default_rng(2)
+        for _ in range(25):
+            la = int(rng.integers(0, 120))
+            lb = int(rng.integers(0, 120))
+            a = rng.integers(0, 5, la).astype(np.int8)
+            b = rng.integers(0, 4, lb).astype(np.int8)
+            res = accuracy.edit_alignment(a, b)
+            assert res["dist"] == _ref_edit(a, b)
+            # one optimal unit-cost path: the class counts must tile it
+            assert res["sub"] + res["ins"] + res["del"] == res["dist"]
+            assert res["matches"] + res["sub"] + res["ins"] == la
+            assert res["matches"] + res["sub"] + res["del"] == lb
+
+    def test_band_growth_is_exact(self):
+        """A pair whose distance exceeds the initial 64-wide band must
+        auto-grow to the exact answer, not clip at the band edge."""
+        rng = np.random.default_rng(3)
+        b = rng.integers(0, 4, 600).astype(np.int8)
+        a = np.concatenate([b[300:], b[:300]])       # heavy rearrangement
+        res = accuracy.edit_alignment(a, b)
+        assert res["dist"] == _ref_edit(a, b)
+
+    def test_n_never_matches_consistently_with_lcs(self):
+        """N==N is not a match in EITHER scorer: identity penalizes it
+        and the class traceback books it as a residual substitution —
+        an N-rich truth can't score 'perfect' in classes while failing
+        the identity floor."""
+        n10 = np.full(10, 4, np.int8)
+        res = accuracy.edit_alignment(n10, n10)
+        assert res["matches"] == 0 and res["sub"] == 10
+        assert int(accuracy.lcs_lengths([(n10, n10)])[0]) == 0
+
+    def test_known_classes(self):
+        b = np.array([0, 1, 2, 3, 0, 1, 2, 3], np.int8)
+        a = b.copy()
+        a[2] = 3                                     # one substitution
+        res = accuracy.edit_alignment(a, b)
+        assert (res["dist"], res["sub"], res["ins"], res["del"]) \
+            == (1, 1, 0, 0)
+        res = accuracy.edit_alignment(np.delete(a, 4), b)
+        assert res["del"] >= 1                       # truth base missing
+
+
+# --------------------------------------------------------------------------
+# scoring + falsifiability
+# --------------------------------------------------------------------------
+
+def _mini_truth_world(seed=5, n=6, L=240, err=0.1):
+    """truth genome segments + noisy 'input' + near-perfect 'corrected'."""
+    rng = np.random.default_rng(seed)
+    truth, before, after = {}, {}, {}
+    for i in range(n):
+        t = rng.integers(0, 4, L).astype(np.int8)
+        noisy = t.copy()
+        mut = rng.random(L) < err
+        noisy[mut] = (noisy[mut] + 1) % 4
+        fixed = t.copy()
+        fixed[rng.integers(0, L)] = (fixed[0] + 1) % 4   # 1 residual sub
+        truth[f"r{i}"] = t
+        before[f"r{i}"] = noisy
+        after[f"r{i}"] = fixed
+    return before, after, truth
+
+
+class TestScoring:
+    def test_score_read_sets_shapes_and_uplift(self):
+        before, after, truth = _mini_truth_world()
+        per_read, s = accuracy.score_read_sets(before, after, truth)
+        assert s["n_scored"] == 6 and s["n_classified"] == 6
+        assert s["identity_after"] > s["identity_before"]
+        assert s["errors_after"]["sub"] <= 6          # ~1 residual each
+        for acc in per_read.values():
+            assert 0.0 <= acc["identity_before"] <= 1.0
+            assert acc["classes"]["sub_introduced"] >= 0
+
+    def test_injected_miscorrection_lowers_identity(self):
+        """Falsifiability: flipping bases in the corrected output MUST
+        measurably lower scored identity and surface as introduced
+        substitutions — a scorer that misses planted damage would wave
+        any quality regression through."""
+        before, after, truth = _mini_truth_world()
+        _, clean = accuracy.score_read_sets(before, after, truth)
+        broken = {}
+        rng = np.random.default_rng(9)
+        for rid, codes in after.items():
+            c = codes.copy()
+            # flip rate above the input error load, so the damage also
+            # shows in the (after - before) introduced-class counts
+            flip = rng.random(len(c)) < 0.2
+            c[flip] = (c[flip] + 1) % 4
+            broken[rid] = c
+        _, dmg = accuracy.score_read_sets(before, broken, truth)
+        assert dmg["identity_after"] < clean["identity_after"] - 0.05
+        assert sum(dmg["introduced"].values()) \
+            > sum(clean["introduced"].values())
+
+    def test_classify_cap_samples_deterministically(self):
+        before, after, truth = _mini_truth_world(n=8)
+        p1, s1 = accuracy.score_read_sets(before, after, truth,
+                                          classify_cap=3)
+        p2, s2 = accuracy.score_read_sets(before, after, truth,
+                                          classify_cap=3)
+        assert s1["n_classified"] == 3
+        assert [r for r, a in p1.items() if a["classes"]] \
+            == [r for r, a in p2.items() if a["classes"]]
+        # identity itself is never sampled
+        assert s1["n_scored"] == 8
+
+    def test_chimera_correctness(self):
+        before, after, truth = _mini_truth_world(n=3)
+        bps = {"r0": [120], "r1": [], "r2": [60]}
+        det = {"r0": [(100, 140)], "r2": [(200, 220)]}
+        per_read, s = accuracy.score_read_sets(
+            before, after, truth, detected_chimera=det,
+            truth_breakpoints=bps, chimera_tol=10)
+        assert per_read["r0"]["chimera"] == {"truth": 1, "detected": 1,
+                                             "matched": 1}
+        assert per_read["r2"]["chimera"] == {"truth": 1, "detected": 1,
+                                             "matched": 0}
+        assert s["chimera"] == {"truth": 2, "detected": 2, "matched": 1}
+
+    def test_apply_to_qc_merges_and_validates(self):
+        from proovread_tpu.io.records import SeqRecord
+        from proovread_tpu.ops.encode import decode_codes
+        before, after, truth = _mini_truth_world(n=4)
+        longs = [SeqRecord(r, decode_codes(c)) for r, c in before.items()]
+        outs = [SeqRecord(r, decode_codes(c)) for r, c in after.items()]
+        rec = obs_qc.QcRecorder()
+        rec.start_bucket(0, longs)
+        summary = accuracy.apply_to_qc(rec, longs, outs, truth)
+        assert summary["n_scored"] == 4
+        for r in rec.iter_records():
+            validate_qc_record(r)
+            assert r["accuracy"] is not None
+        agg = rec.aggregate()
+        assert agg["accuracy"]["n_scored"] == 4
+        assert agg["accuracy"]["identity_after"]["mean"] \
+            >= agg["accuracy"]["identity_before"]["mean"]
+
+
+# --------------------------------------------------------------------------
+# truth sidecar: write -> validate -> load round trip, and through the CLI
+# --------------------------------------------------------------------------
+
+class TestTruthSidecar:
+    def test_round_trip(self, tmp_path):
+        from proovread_tpu.io.simulate import (random_genome,
+                                               simulate_long_reads,
+                                               write_truth_sidecar)
+        g = random_genome(4000, seed=3)
+        longs, truths, bps = simulate_long_reads(
+            g, 6000, mean_len=700, min_len=400, seed=4,
+            chimera_frac=0.5, with_breakpoints=True)
+        p = str(tmp_path / "truth.jsonl")
+        write_truth_sidecar(p, longs, truths, breakpoints=bps)
+        stats = validate_truth_sidecar(p, min_reads=len(longs))
+        assert stats["n_records"] == len(longs)
+        assert stats["n_chimeric"] == sum(1 for b in bps if b)
+        tm, bm = accuracy.load_truth_sidecar(p)
+        for r, t, b in zip(longs, truths, bps):
+            assert (tm[r.id] == t).all()
+            assert bm[r.id] == list(b)
+
+    def test_chimera_frac_zero_is_byte_identical(self):
+        """The chimera stream is a SEPARATE rng: default simulation
+        output must stay byte-identical to earlier rounds (BENCH/COMPILE
+        row comparability)."""
+        from proovread_tpu.io.simulate import (random_genome,
+                                               simulate_long_reads)
+        g = random_genome(4000, seed=3)
+        a1, t1 = simulate_long_reads(g, 6000, seed=4)
+        a2, t2, bp = simulate_long_reads(g, 6000, seed=4,
+                                         chimera_frac=0.0,
+                                         with_breakpoints=True)
+        assert [r.seq for r in a1] == [r.seq for r in a2]
+        assert all(b == [] for b in bp)
+
+    def test_validator_rejects_drift(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        meta = json.dumps({"truth_schema": 1, "n_reads": 1})
+        good = {"id": "a", "seq": "ACGT", "breakpoints": []}
+        p.write_text(meta + "\n"
+                     + json.dumps({**good, "sneaky": 1}) + "\n")
+        with pytest.raises(ValidationError, match="undeclared"):
+            validate_truth_sidecar(str(p))
+        p.write_text(meta + "\n"
+                     + json.dumps({**good, "breakpoints": [99]}) + "\n")
+        with pytest.raises(ValidationError, match="breakpoint"):
+            validate_truth_sidecar(str(p))
+        p.write_text(meta + "\n" + json.dumps(good) + "\n")
+        assert validate_truth_sidecar(str(p))["n_records"] == 1
+        assert obs_validate.main(["--truth-sidecar", str(p)]) == 0
+
+    def test_cli_truth_round_trip(self, tmp_path):
+        """simulate -> write sidecar + FASTQs -> real CLI run with
+        --truth -> the scored, strictly-valid QC artifact (the sidecar
+        is how subprocess runs get their identity-at-scale numbers)."""
+        from proovread_tpu.cli import main as cli_main
+        from proovread_tpu.io.fastq import FastqWriter
+        from proovread_tpu.io.simulate import (
+            simulate_independent_segments, write_truth_sidecar)
+        longs, srs, truths = simulate_independent_segments(
+            seed=11, n_long=2, read_len=300, sr_per=8, with_truth=True)
+        lp, sp = str(tmp_path / "l.fq"), str(tmp_path / "s.fq")
+        for path, recs in ((lp, longs), (sp, srs)):
+            with open(path, "wb") as fh:
+                w = FastqWriter(fh)
+                for r in recs:
+                    w.write(r)
+        tp = str(tmp_path / "truth.jsonl")
+        write_truth_sidecar(tp, longs, truths)
+        cfgp = str(tmp_path / "t.cfg")
+        with open(cfgp, "w") as fh:
+            json.dump({"batch-reads": 8, "device-chunk": 128,
+                       "engine": "scan",
+                       "seq-filter": {"--min-length": 150}}, fh)
+        out = str(tmp_path / "res")
+        qcp = str(tmp_path / "run.qc.jsonl")
+        rc = cli_main(["-l", lp, "-s", sp, "-p", out, "-m", "sr-noccs",
+                       "-c", cfgp, "--qc-out", qcp, "--truth", tp,
+                       "--quiet"])
+        assert rc == 0
+        stats = validate_qc(qcp, min_reads=2)
+        acc = stats["aggregate"]["accuracy"]
+        assert acc is not None and acc["n_scored"] == 2
+        assert acc["identity_after"]["mean"] \
+            >= acc["identity_before"]["mean"]
+        with open(qcp) as fh:
+            next(fh)
+            for line in fh:
+                r = json.loads(line)
+                assert r["accuracy"] is not None
+                assert r["accuracy"]["identity_after"] > 0
+
+
+# --------------------------------------------------------------------------
+# zero-overhead guard (QC/ledger pattern): no truth sidecar -> no scoring
+# --------------------------------------------------------------------------
+
+def test_accuracy_zero_overhead_when_off(monkeypatch, tmp_path):
+    """Tier-1 twin of test_qc_zero_overhead_when_off: a run without
+    --truth must never touch the scorer — not the LCS sweep, not the
+    classifier, not the QC merge — and its records keep accuracy=None."""
+    from proovread_tpu.io.records import SeqRecord
+    from proovread_tpu.ops.encode import decode_codes
+    from proovread_tpu.pipeline import Pipeline, PipelineConfig, TrimParams
+
+    def _boom(*a, **k):                                 # noqa: ANN001
+        raise AssertionError("accuracy machinery ran without --truth")
+
+    for name in ("score_read_sets", "apply_to_qc", "lcs_lengths",
+                 "edit_alignment", "load_truth_sidecar"):
+        monkeypatch.setattr(accuracy, name, _boom)
+    monkeypatch.setattr(obs_qc.QcRecorder, "record_accuracy", _boom)
+
+    rng = np.random.default_rng(11)
+    genome = rng.integers(0, 4, 400).astype(np.int8)
+    longs = [SeqRecord(f"r{i}", decode_codes(genome[s:s + 200]))
+             for i, s in enumerate((0, 100))]
+    srs = [SeqRecord(f"s{i}", decode_codes(genome[s:s + 100]),
+                     qual=np.full(100, 30, np.uint8))
+           for i, s in enumerate(rng.integers(0, 300, 30))]
+    with obs_qc.scope() as rec:
+        res = Pipeline(PipelineConfig(
+            mode="sr", n_iterations=1, sampling=False, engine="scan",
+            batch_reads=8, trim=TrimParams(min_length=100))).run(longs,
+                                                                 srs)
+    assert len(res.untrimmed) == 2
+    assert all(r["accuracy"] is None for r in rec.iter_records())
+    assert res.qc["accuracy"] is None
+
+
+# --------------------------------------------------------------------------
+# the gate: verdict units, pool isolation, rc-1 falsifiability
+# --------------------------------------------------------------------------
+
+def _row(identity_after, identity_before=0.85, config=4, backend="cpu",
+         mesh=None, introduced=None, **kw):
+    r = {"metric": "accuracy", "schema": 1, "config": config,
+         "backend": backend, "mesh_shards": mesh,
+         "identity_before": identity_before,
+         "identity_after": identity_after,
+         "introduced": introduced}
+    r.update(kw)
+    return r
+
+
+def _entries(*rows):
+    return [{"source": f"ACCURACY_r{i:02d}.json", "row": r}
+            for i, r in enumerate(rows)]
+
+
+class TestGate:
+    def test_pass_on_healthy_history(self):
+        v = accuracy.accuracy_check(_entries(
+            _row(0.998), _row(0.9985), _row(0.9982)))
+        assert v["verdict"] == "PASS"
+
+    def test_floor_trips_without_any_baseline(self):
+        """The injected-regression demonstration works BEFORE real
+        history exists: floor + uplift are per-row checks."""
+        v = accuracy.accuracy_check(_entries(_row(0.91)))
+        assert v["verdict"] == "REGRESSION"
+        assert any(c["check"].endswith("identity_floor")
+                   and c["status"] == "regressed" for c in v["checks"])
+
+    def test_uplift_trips(self):
+        v = accuracy.accuracy_check(_entries(
+            _row(0.96, identity_before=0.97)))
+        assert v["verdict"] == "REGRESSION"
+        assert any(c["check"].endswith("identity_uplift")
+                   and c["status"] == "regressed" for c in v["checks"])
+
+    def test_identity_drop_vs_baseline_trips(self):
+        v = accuracy.accuracy_check(_entries(
+            _row(0.999), _row(0.9988), _row(0.993)))
+        assert v["verdict"] == "REGRESSION"
+        assert any(c["check"].endswith(":identity_after")
+                   and c["status"] == "regressed" for c in v["checks"])
+
+    def test_introduced_errors_trip(self):
+        v = accuracy.accuracy_check(_entries(
+            _row(0.998, introduced={"sub": 4, "ins": 1, "del": 0}),
+            _row(0.998, introduced={"sub": 5, "ins": 1, "del": 1}),
+            _row(0.998, introduced={"sub": 80, "ins": 10, "del": 5})))
+        assert v["verdict"] == "REGRESSION"
+        assert any(c["check"].endswith("introduced_errors")
+                   and c["status"] == "regressed" for c in v["checks"])
+
+    def test_pool_isolation(self):
+        """A regressed-looking CPU row never compares against chip rows,
+        and a mesh row never against single-device rows."""
+        v = accuracy.accuracy_check(_entries(
+            _row(0.9995, backend="tpu"),
+            _row(0.9990, backend="tpu"),
+            _row(0.9960, backend="cpu"),        # different pool: no drop
+            _row(0.9961, config="dmesh", mesh=4)))
+        assert v["verdict"] == "PASS"
+        assert "configdmesh/cpu/mesh4" in v["pools"]
+
+    def test_skipped_rows_pool_nonfatally(self):
+        v = accuracy.accuracy_check(_entries(
+            _row(0.998),
+            {"metric": "accuracy", "config": 4, "backend": "cpu",
+             "identity_after": None,
+             "accuracy_skipped": "wall budget fired before scoring"},
+            _row(0.998)))
+        assert v["verdict"] == "PASS"
+        missing = [c for c in v["checks"] if c["status"] == "missing"]
+        assert missing and "accuracy_skipped" in missing[0]["note"]
+
+    def test_cli_check_rc1_on_injected_drop(self, tmp_path, monkeypatch,
+                                            capsys):
+        monkeypatch.chdir(tmp_path)
+        with open("ACCURACY_r01.json", "w") as fh:
+            fh.write(json.dumps(_row(0.998)) + "\n")
+            fh.write(json.dumps(_row(0.90)) + "\n")
+        assert accuracy.main(["check"]) == 1
+        assert "ACCURACY-REGRESSION" in capsys.readouterr().err
+        with open("ACCURACY_r01.json", "w") as fh:
+            fh.write(json.dumps(_row(0.998)) + "\n")
+            fh.write(json.dumps(_row(0.9979)) + "\n")
+        assert accuracy.main(["check"]) == 0
+
+    def test_local_record_files_order_after_rounds(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        for name in ("ACCURACY_record.json", "ACCURACY_r02.json",
+                     "ACCURACY_r01.json"):
+            with open(name, "w") as fh:
+                fh.write(json.dumps(_row(0.998)) + "\n")
+        assert accuracy._resolve_paths([]) == [
+            "ACCURACY_r01.json", "ACCURACY_r02.json",
+            "ACCURACY_record.json"]
+
+
+# --------------------------------------------------------------------------
+# regress.py: BENCH-row identity check with legacy tolerance
+# --------------------------------------------------------------------------
+
+class TestBenchIdentityCheck:
+    def _bench_row(self, value=100.0, **kw):
+        r = {"metric": "corrected_bases_per_sec_per_chip",
+             "value": value, "config": 4, "backend": "cpu",
+             "wall_s": 10.0}
+        r.update(kw)
+        return r
+
+    def test_legacy_rows_never_keyerror(self, tmp_path):
+        """r01-r07-style history: rows with NO identity fields at all
+        pool non-fatally (the satellite: no KeyError on legacy rows)."""
+        from proovread_tpu.obs.regress import perf_check
+        entries = [{"source": f"BENCH_r{i:02d}.json", "n": i, "rc": 0,
+                    "row": self._bench_row()} for i in range(1, 4)]
+        v = perf_check(entries)
+        assert v["verdict"] == "PASS"
+        assert not any(c["check"] == "identity_after"
+                       for c in v["checks"] if c["status"] == "regressed")
+
+    def test_identity_drop_regresses(self):
+        from proovread_tpu.obs.regress import perf_check
+        acc = {"n_scored": 6}                 # scoreboard-methodology marker
+        entries = [
+            {"source": "a", "n": 1, "rc": 0,
+             "row": self._bench_row(identity_after=0.999, accuracy=acc)},
+            {"source": "b", "n": 2, "rc": 0,
+             "row": self._bench_row(identity_after=0.9985, accuracy=acc)},
+            {"source": "c", "n": 3, "rc": 0,
+             "row": self._bench_row(identity_after=0.98, accuracy=acc)},
+        ]
+        v = perf_check(entries)
+        assert v["verdict"] == "REGRESSION"
+        assert any(c["check"] == "identity_after"
+                   and c["status"] == "regressed" for c in v["checks"])
+
+    def test_legacy_sampler_identity_never_baselines(self):
+        """Pre-PR10 identity_after came from the bounded SW sampler — a
+        different statistic. A scoreboard row landing below it must pool
+        as skipped (methodology fence), not as a regression."""
+        from proovread_tpu.obs.regress import perf_check
+        entries = [
+            {"source": "a", "n": 1, "rc": 0,
+             "row": self._bench_row(identity_after=0.999)},   # no dict
+            {"source": "b", "n": 2, "rc": 0,
+             "row": self._bench_row(identity_after=0.99,
+                                    accuracy={"n_scored": 6})},
+        ]
+        v = perf_check(entries)
+        assert v["verdict"] == "PASS"
+        idc = [c for c in v["checks"] if c["check"] == "identity_after"]
+        assert idc and idc[0]["status"] == "skipped"
+        assert "not comparable" in idc[0]["note"]
+
+    def test_skipped_scoring_is_missing_not_fatal(self):
+        from proovread_tpu.obs.regress import perf_check
+        entries = [
+            {"source": "a", "n": 1, "rc": 0,
+             "row": self._bench_row(identity_after=0.999,
+                                    accuracy={"n_scored": 6})},
+            {"source": "b", "n": 2, "rc": 0,
+             "row": self._bench_row(identity_after=None,
+                                    accuracy_skipped="scoring failed")},
+        ]
+        v = perf_check(entries)
+        assert v["verdict"] == "PASS"
+        miss = [c for c in v["checks"] if c["check"] == "identity_after"]
+        assert miss and miss[0]["status"] == "missing"
+        assert "scoring failed" in miss[0]["note"]
+
+
+# --------------------------------------------------------------------------
+# QC schema: the accuracy field is strictly declared
+# --------------------------------------------------------------------------
+
+class TestQcAccuracySchema:
+    def _acc(self):
+        return {"identity_before": 0.85, "identity_after": 0.99,
+                "lcs_before": 170, "lcs_after": 198, "truth_len": 200,
+                "classes": None, "chimera": None}
+
+    def test_valid_record(self):
+        r = obs_qc.new_record("x")
+        r["accuracy"] = self._acc()
+        validate_qc_record(r)
+
+    def test_undeclared_subfield_fails(self):
+        r = obs_qc.new_record("x")
+        r["accuracy"] = {**self._acc(), "sneaky": 1}
+        with pytest.raises(ValidationError, match="undeclared"):
+            validate_qc_record(r)
+
+    def test_identity_out_of_range_fails(self):
+        r = obs_qc.new_record("x")
+        r["accuracy"] = {**self._acc(), "identity_after": 1.5}
+        with pytest.raises(ValidationError, match="not in"):
+            validate_qc_record(r)
+
+    def test_class_schema_strict(self):
+        r = obs_qc.new_record("x")
+        classes = {f"{k}_{s}": 0 for k in ("sub", "ins", "del")
+                   for s in ("before", "after", "introduced")}
+        r["accuracy"] = {**self._acc(), "classes": classes}
+        validate_qc_record(r)
+        del classes["sub_after"]
+        with pytest.raises(ValidationError, match="missing"):
+            validate_qc_record(r)

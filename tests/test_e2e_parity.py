@@ -139,13 +139,19 @@ class TestEndToEndParity:
             sam, ref, out, use_ref_qual=False, max_cov=FINISH_COV)}
 
         # ---- compare ----------------------------------------------------
-        import bench
+        # identity via the shared accuracy scoreboard (obs/accuracy.py;
+        # bench.py's old quadratic SW sampler is deleted): LCS maximizes
+        # alignment matches, so LCS / max(len) is the same
+        # matches-over-max-length statistic at the 0.999 bar
+        from proovread_tpu.obs.accuracy import lcs_lengths
         pairs = []
         for r in longs:
             if r.id in ours and r.id in perl_final:
                 pairs.append((encode_ascii(ours[r.id].seq),
                               encode_ascii(perl_final[r.id].seq)))
         assert len(pairs) >= 10
-        idents = bench.true_identity(pairs)
+        lcs = lcs_lengths(pairs)
+        idents = [int(l) / max(len(a), len(b), 1)
+                  for l, (a, b) in zip(lcs, pairs)]
         mean_ident = float(np.mean(idents))
         assert mean_ident >= 0.999, (mean_ident, sorted(idents)[:3])

@@ -171,13 +171,15 @@ class TestQcSchema:
         with pytest.raises(ValidationError, match="meta"):
             validate_qc(str(p))
         # meta count mismatch
-        p.write_text(json.dumps({"qc_schema": 1, "n_reads": 2,
+        p.write_text(json.dumps({"qc_schema": obs_qc.QC_SCHEMA_VERSION,
+                                 "n_reads": 2,
                                  "aggregate": {}}) + "\n"
                      + json.dumps(obs_qc.new_record("a")) + "\n")
         with pytest.raises(ValidationError, match="n_reads"):
             validate_qc(str(p))
         # duplicate ids
-        p.write_text(json.dumps({"qc_schema": 1, "n_reads": 2,
+        p.write_text(json.dumps({"qc_schema": obs_qc.QC_SCHEMA_VERSION,
+                                 "n_reads": 2,
                                  "aggregate": {}}) + "\n"
                      + json.dumps(obs_qc.new_record("a")) + "\n"
                      + json.dumps(obs_qc.new_record("a")) + "\n")
