@@ -16,7 +16,7 @@
 #   make accuracy-check   identity floor + no-regression gate over ACCURACY_*.json
 #   make bench        the benchmark itself (one JSON row on stdout)
 
-.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke perf-check perf-report prewarm compile-check accuracy-record accuracy-check bench
+.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke perf-check perf-report prewarm compile-check accuracy-record accuracy-check static-check bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -134,6 +134,18 @@ accuracy-check:
 # from the same history instead of hand-assembled op traces
 perf-report:
 	python -m proovread_tpu.obs.regress report
+
+# program-contract static analysis (docs/STATIC_ANALYSIS.md): traces
+# every registered jitted/Pallas entry point at abstract shapes and
+# enforces the contracts — gather-free chunk scans, declared-dead slabs
+# donated, no host syncs / wide dtypes / packed upcasts in hot paths —
+# plus the compile-key zoo predictor gated against the committed
+# per-entry program budget (analysis/budget.json) and reconciled
+# (predicted ⊇ observed) against the recorded LEDGER_*.jsonl artifact.
+# Exits 1 only on NEW violations (vs analysis/baseline.json), budget
+# growth, or a reconciliation miss — the gate is a ratchet.
+static-check:
+	JAX_PLATFORMS=cpu python -m proovread_tpu.analysis check
 
 bench:
 	python bench.py

@@ -1,0 +1,40 @@
+"""Program-contract static analysis (docs/STATIC_ANALYSIS.md).
+
+The repo's performance story is a set of *contracts* on every jitted /
+Pallas program: chunk loops stay gather-free (PR 7), the program zoo
+stays within a per-entry budget (PR 9 measured it; ROADMAP item 1 tears
+it down), big dead slabs are donated, traced values never sync to the
+host mid-pass, and packed code arrays never silently widen. Until this
+package those contracts lived in two ad-hoc tests
+(``tests/test_no_gather.py``'s jaxpr walk, ``test_no_naked_timers``'s
+AST scan) and in review vigilance. ``make static-check`` enforces all of
+them *before anything runs*, ratcheted by a committed baseline so
+existing debts are recorded rather than waved through.
+
+Layout:
+
+- ``engine.py``  — the jaxpr/AST rule engine: traversal primitives
+  (promoted from tests/test_no_gather.py), the rule registries, the
+  violation model and the baseline ratchet.
+- ``rules.py``   — the built-in rules: no-gather, donation, host-sync
+  (AST + jaxpr), dtype (wide-dtype leaks, packed-array upcasts),
+  naked-timer.
+- ``entrypoints.py`` — the registry of jitted/Pallas entry points with
+  abstract-argument builders (small shapes for rules) and declared
+  argument lifetimes (the donation contract).
+- ``shapes.py``  — the shape oracle: per-config bucket tables rebuilt
+  from the real workload builders through the driver's own bucketing
+  helpers.
+- ``predict.py`` — the compile-key zoo predictor: enumerates distinct
+  (entry, abstract-signature) programs per config with the SAME
+  signature hash as ``obs/compilecache.py``, gates them against the
+  committed per-entry budget, and reconciles predicted ⊇ observed
+  against a recorded compile ledger.
+- ``__main__.py`` — the ``make static-check`` CLI.
+"""
+
+from proovread_tpu.analysis.engine import (Violation, ast_rule,  # noqa: F401
+                                           jaxpr_rule, kernel_scan_bodies,
+                                           load_baseline, ratchet,
+                                           run_ast_rules, run_jaxpr_rules,
+                                           sub_jaxprs, walk)

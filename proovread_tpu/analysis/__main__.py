@@ -1,0 +1,237 @@
+"""``make static-check`` — the program-contract gate.
+
+::
+
+    python -m proovread_tpu.analysis check [--configs 4,3]
+        [--ledger LEDGER_*.jsonl] [--baseline PATH] [--budget PATH]
+    python -m proovread_tpu.analysis predict --config 4 [--out FILE]
+    python -m proovread_tpu.analysis baseline        # accept current debts
+    python -m proovread_tpu.analysis budget          # accept current zoo
+
+``check`` runs, in order:
+
+1. the AST rules (naked-timer, host-sync-ast) over the source tree;
+2. the jaxpr rules (no-gather, donation, host-sync, wide-dtype,
+   packed-upcast) over every traced registry entry point;
+3. the census predictor per config, gated against the committed
+   per-entry program budget (``analysis/budget.json``);
+4. predicted ⊇ observed reconciliation against the newest recorded
+   compile-ledger artifact (``LEDGER_*.jsonl`` at the repo root).
+
+Exit 1 ONLY on: a violation not in the committed baseline
+(``analysis/baseline.json``), a budget breach, a reconciliation miss, or
+an engine error (a spec that fails to trace is an error, not a skip).
+Standing debts and shrinkable budgets are reported, keeping the gate a
+ratchet: debts can only be paid down, the zoo can only shrink.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _default_ledger() -> Optional[str]:
+    cands = sorted(_glob.glob(os.path.join(ROOT, "LEDGER_*.jsonl")))
+    return cands[-1] if cands else None
+
+
+def _collect_violations():
+    from proovread_tpu.analysis import engine
+    from proovread_tpu.analysis import rules  # noqa: F401  (registers)
+    from proovread_tpu.analysis.entrypoints import registry
+    ast_v = engine.run_ast_rules()
+    jaxpr_v, errors = engine.run_jaxpr_rules(registry())
+    return ast_v + jaxpr_v, errors
+
+
+def cmd_check(args) -> int:
+    from proovread_tpu.analysis import engine, predict
+
+    rc = 0
+    print("static-check: tracing entry points and running rules...",
+          file=sys.stderr)
+    violations, errors = _collect_violations()
+    for e in errors:
+        print(f"STATIC-ERROR: {e}", file=sys.stderr)
+        rc = 1
+
+    baseline = engine.load_baseline(args.baseline)
+    r = engine.ratchet(violations, baseline)
+    for v in r["new"]:
+        print(f"STATIC-VIOLATION: {v.render()}", file=sys.stderr)
+        rc = 1
+    for v in r["known"]:
+        print(f"static-check: standing debt {v.key}", file=sys.stderr)
+    for key in r["resolved"]:
+        print(f"static-check: debt PAID — remove from baseline: {key}",
+              file=sys.stderr)
+
+    budget = predict.load_budget(args.budget)
+    configs = [int(c) for c in args.configs.split(",") if c]
+    predictions = {}
+    for cfg in configs:
+        pred = predict.predict_config(cfg)
+        predictions[cfg] = pred
+        bc = predict.budget_check(pred, budget)
+        for b in bc["breaches"]:
+            print(f"STATIC-BUDGET: {bc['pool']}/{b['entry']}: predicted "
+                  f"{b['predicted']} program(s) vs budget {b['budget']}"
+                  + (f" — {b['note']}" if b.get("note") else ""),
+                  file=sys.stderr)
+            rc = 1
+        for entry, d in sorted(bc["shrinkable"].items()):
+            print(f"static-check: {bc['pool']}/{entry} budget "
+                  f"{d['budget']} > predicted {d['predicted']} — "
+                  "ratchet the budget down", file=sys.stderr)
+
+    ledger = args.ledger or _default_ledger()
+    recon = None
+    if ledger and os.path.exists(ledger):
+        led_cfg = args.ledger_config
+        if led_cfg is None:
+            import re as _re
+            m = _re.search(r"config(\d+)", os.path.basename(ledger))
+            led_cfg = int(m.group(1)) if m else 4
+        # the interpret static is part of every compile key: predict
+        # with the flavor the ledger's backend actually compiled
+        itp = predict.interpret_for_backend(predict.ledger_backend(ledger))
+        pred = (predictions.get(led_cfg) if itp
+                else predict.predict_config(led_cfg, interpret=False))
+        if pred is None:
+            pred = predict.predict_config(led_cfg, interpret=itp)
+        observed = predict.load_ledger_programs(ledger)
+        recon = predict.reconcile(pred, observed)
+        for m in recon["missing"]:
+            print(f"STATIC-RECONCILE: config{led_cfg}: observed program "
+                  f"not predicted: {json.dumps(m)} — the shape oracle "
+                  "lost a call site (analysis/predict.py recipes)",
+                  file=sys.stderr)
+            rc = 1
+        for e in recon["unmodeled"]:
+            print(f"STATIC-RECONCILE: config{led_cfg}: ledger entry "
+                  f"{e!r} has no predictor recipe — model it or record "
+                  "why it cannot be", file=sys.stderr)
+            rc = 1
+        for entry, n in sorted(recon["unobserved"].items()):
+            print(f"static-check: {entry}: {n} predicted program(s) "
+                  f"never observed in {os.path.basename(ledger)} "
+                  "(superset slack / stale-budget candidates)",
+                  file=sys.stderr)
+    else:
+        print("static-check: no LEDGER_*.jsonl artifact found — "
+              "reconciliation skipped (record one with --compile-ledger "
+              "through the CLI)", file=sys.stderr)
+
+    report = {
+        "schema": 1,
+        "verdict": "FAIL" if rc else "PASS",
+        "violations": {
+            "new": [v.key for v in r["new"]],
+            "known": [v.key for v in r["known"]],
+            "resolved": r["resolved"],
+        },
+        "errors": errors,
+        "budget": {f"config{c}": predictions[c]["by_entry"]
+                   for c in predictions},
+        "reconcile": recon,
+    }
+    print(json.dumps(report, sort_keys=True))
+    print(f"static-check: {report['verdict']} "
+          f"({len(violations)} violation(s), {len(r['new'])} new; "
+          f"{sum(p['n_programs'] for p in predictions.values())} "
+          "predicted program(s))", file=sys.stderr)
+    return rc
+
+
+def cmd_predict(args) -> int:
+    from proovread_tpu.analysis import predict
+    pred = predict.predict_config(args.config, cap_bases=args.cap_bases)
+    text = json.dumps(pred, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+        print(f"predicted census -> {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+def cmd_baseline(args) -> int:
+    from proovread_tpu.analysis import engine
+    violations, errors = _collect_violations()
+    for e in errors:
+        print(f"STATIC-ERROR: {e}", file=sys.stderr)
+    if errors:
+        print("baseline NOT written: fix trace errors first",
+              file=sys.stderr)
+        return 1
+    path = engine.save_baseline(violations, args.baseline)
+    print(f"{len(violations)} debt(s) -> {path}", file=sys.stderr)
+    return 0
+
+
+def cmd_budget(args) -> int:
+    from proovread_tpu.analysis import predict
+    per = {}
+    for cfg in (int(c) for c in args.configs.split(",") if c):
+        pred = predict.predict_config(cfg)
+        per[f"config{cfg}"] = pred["by_entry"]
+    path = predict.save_budget(per, args.budget)
+    print(f"budget -> {path}", file=sys.stderr)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="proovread-tpu-analysis",
+        description="Program-contract static analysis "
+                    "(docs/STATIC_ANALYSIS.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    chk = sub.add_parser("check", help="the make static-check gate")
+    chk.add_argument("--configs", default="4,3")
+    chk.add_argument("--ledger", default=None,
+                     help="recorded compile-ledger JSONL to reconcile "
+                          "against (default: newest LEDGER_*.jsonl)")
+    chk.add_argument("--ledger-config", type=int, default=None,
+                     help="which config the ledger artifact recorded "
+                          "(default: parsed from its 'configN' filename "
+                          "segment, else 4)")
+    chk.add_argument("--baseline", default=None)
+    chk.add_argument("--budget", default=None)
+    chk.set_defaults(fn=cmd_check)
+
+    pr = sub.add_parser("predict", help="emit one config's predicted "
+                                        "census")
+    pr.add_argument("--config", type=int, default=4)
+    pr.add_argument("--cap-bases", type=int, default=None)
+    pr.add_argument("--out", default=None)
+    pr.set_defaults(fn=cmd_predict)
+
+    bl = sub.add_parser("baseline",
+                        help="rewrite the debt file from current "
+                             "violations (explicit debt acceptance)")
+    bl.add_argument("--baseline", default=None)
+    bl.set_defaults(fn=cmd_baseline)
+
+    bd = sub.add_parser("budget",
+                        help="rewrite the program budget from current "
+                             "predictions (explicit zoo acceptance)")
+    bd.add_argument("--configs", default="4,3")
+    bd.add_argument("--budget", default=None)
+    bd.set_defaults(fn=cmd_budget)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

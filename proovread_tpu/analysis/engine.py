@@ -1,0 +1,344 @@
+"""The rule engine: jaxpr traversal, rule registries, baseline ratchet.
+
+**Jaxpr rules** run over *traced* entry points: each registered entry
+point (``entrypoints.py``) is traced at abstract shapes (tracing never
+executes or compiles — a whole-repo sweep stays seconds, not minutes)
+and every rule walks the resulting jaxpr. The traversal primitives are
+the ones ``tests/test_no_gather.py`` proved out (promoted here verbatim;
+the test now asserts against THIS module, so the lint and the engine can
+never drift apart).
+
+**AST rules** run over source files — the ``test_no_naked_timers``
+pattern generalized: each rule declares its own file/function scope and
+walks the parsed AST. A line may opt out with an inline
+``# static-ok: <reason>`` comment (for sites that *look* like a
+violation but are host-side by construction); real debts belong in the
+baseline instead, where they stay visible and ratcheted.
+
+**The ratchet** (:func:`ratchet`): violations are keyed by
+``rule::where::detail`` — stable identifiers without line numbers, so
+unrelated edits never invalidate the baseline. ``make static-check``
+exits 1 only on violations NOT in the committed baseline
+(``analysis/baseline.json``); baselined debts are reported as standing
+debt, and baseline entries that no longer fire are reported so the file
+can be ratcheted *down* (paying a debt shrinks the baseline, never
+silently).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+BASELINE_SCHEMA = 1
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+# --------------------------------------------------------------------------
+# jaxpr traversal (promoted from tests/test_no_gather.py — the test now
+# imports these, planting its falsifiability gather against the engine)
+# --------------------------------------------------------------------------
+
+def _jax_core():
+    from jax.extend import core as jax_core
+    return jax_core
+
+
+def sub_jaxprs(eqn):
+    """Immediate child jaxprs of one equation (scan/cond/while/pjit/...)."""
+    jax_core = _jax_core()
+    for v in eqn.params.values():
+        if isinstance(v, jax_core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jax_core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                if isinstance(x, jax_core.ClosedJaxpr):
+                    yield x.jaxpr
+                elif isinstance(x, jax_core.Jaxpr):
+                    yield x
+
+
+def walk(jaxpr, *, into_pallas: bool = False):
+    """All equations under ``jaxpr``, depth-first. Pallas kernel bodies
+    are excluded by default: they are Mosaic-compiled and never lower to
+    XLA scalar-core ops, so XLA-level rules must not see them."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        if eqn.primitive.name == "pallas_call" and not into_pallas:
+            continue
+        for sub in sub_jaxprs(eqn):
+            yield from walk(sub, into_pallas=into_pallas)
+
+
+def contains_pallas(jaxpr) -> bool:
+    return any(e.primitive.name == "pallas_call" for e in walk(jaxpr))
+
+
+def kernel_scan_bodies(closed) -> list:
+    """Bodies of every ``scan`` that contains a ``pallas_call`` — the
+    chunk loops of the fused path. Scans without kernels (the seeder's
+    probe-slab scan, admission's searchsorted) run once per pass, not
+    once per chunk, and are out of scope."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    out: list = []
+
+    def visit(j):
+        for eqn in j.eqns:
+            subs = list(sub_jaxprs(eqn))
+            if eqn.primitive.name == "scan":
+                out.extend(s for s in subs if contains_pallas(s))
+            if eqn.primitive.name != "pallas_call":
+                for s in subs:
+                    visit(s)
+
+    visit(jaxpr)
+    return out
+
+
+# --------------------------------------------------------------------------
+# violations
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Violation:
+    """One contract breach.
+
+    ``where`` is a stable location (``entry:<name>`` for jaxpr rules,
+    ``<relpath>::<qualified fn>`` for AST rules); ``detail`` is a stable
+    discriminator (op name, argument index, pattern + ordinal) — never a
+    line number, so baseline keys survive unrelated edits. ``message``
+    is the human rendering and is NOT part of the identity."""
+    rule: str
+    where: str
+    detail: str
+    message: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.where}::{self.detail}"
+
+    def render(self) -> str:
+        msg = f" — {self.message}" if self.message else ""
+        return f"[{self.rule}] {self.where} ({self.detail}){msg}"
+
+
+# --------------------------------------------------------------------------
+# rule registries
+# --------------------------------------------------------------------------
+
+# name -> fn(spec, traced: TracedEntry) -> List[Violation]
+JAXPR_RULES: Dict[str, Callable] = {}
+# name -> fn(root: str) -> List[Violation]
+AST_RULES: Dict[str, Callable] = {}
+
+
+def jaxpr_rule(name: str):
+    def deco(fn):
+        fn.rule_name = name
+        JAXPR_RULES[name] = fn
+        return fn
+    return deco
+
+
+def ast_rule(name: str):
+    def deco(fn):
+        fn.rule_name = name
+        AST_RULES[name] = fn
+        return fn
+    return deco
+
+
+@dataclass
+class TracedEntry:
+    """One entry point traced at abstract shapes, plus a lazy lowering
+    (the donation rule needs ``Lowered.args_info``; everything else only
+    walks the jaxpr)."""
+    spec: Any                     # entrypoints.EntrySpec
+    closed: Any                   # ClosedJaxpr
+    args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+    _lowered: Any = None
+
+    def lowered(self):
+        if self._lowered is None:
+            fn = self.spec.fn()
+            self._lowered = fn.lower(*self.args, **self.kwargs)
+        return self._lowered
+
+
+def trace_entry(spec) -> TracedEntry:
+    """Trace one registry entry at its small representative abstract
+    shapes. Uses the jit object's AOT ``.trace`` (the ``attributed``
+    wrapper forwards it), which accepts ``ShapeDtypeStruct`` leaves and
+    never executes device code."""
+    args, kwargs = spec.build_args()
+    fn = spec.fn()
+    traced = fn.trace(*args, **kwargs)
+    return TracedEntry(spec=spec, closed=traced.jaxpr,
+                       args=args, kwargs=kwargs)
+
+
+def run_jaxpr_rules(specs, rules: Optional[List[str]] = None
+                    ) -> Tuple[List[Violation], List[str]]:
+    """Trace every spec once, run every (selected) jaxpr rule over it.
+    Returns (violations, errors) — a spec that fails to trace is an
+    itemized error, never a silent skip."""
+    sel = {n: JAXPR_RULES[n] for n in (rules or JAXPR_RULES)}
+    violations: List[Violation] = []
+    errors: List[str] = []
+    for spec in specs:
+        try:
+            traced = trace_entry(spec)
+        except Exception as e:                          # noqa: BLE001
+            errors.append(f"entry:{spec.name}: trace failed: "
+                          f"{type(e).__name__}: {e}")
+            continue
+        for name, fn in sel.items():
+            try:
+                violations.extend(fn(spec, traced))
+            except Exception as e:                      # noqa: BLE001
+                errors.append(f"entry:{spec.name}: rule {name} failed: "
+                              f"{type(e).__name__}: {e}")
+    return violations, errors
+
+
+def run_ast_rules(root: Optional[str] = None,
+                  rules: Optional[List[str]] = None) -> List[Violation]:
+    root = root or _PKG_ROOT
+    out: List[Violation] = []
+    for name in (rules or AST_RULES):
+        out.extend(AST_RULES[name](root))
+    return out
+
+
+# -- AST helpers (shared by rules.py) --------------------------------------
+
+STATIC_OK_MARK = "static-ok:"
+
+
+def parse_module(path: str):
+    """(ast tree, source lines, set of static-ok line numbers).
+
+    A ``# static-ok: <reason>`` marker covers its own line (trailing
+    comment) and, when it sits inside a comment block, the first code
+    line below the block — the natural place to annotate a flagged
+    statement."""
+    with open(path) as fh:
+        src = fh.read()
+    lines = src.splitlines()
+    ok_lines = set()
+    for i, ln in enumerate(lines):
+        if STATIC_OK_MARK not in ln:
+            continue
+        ok_lines.add(i + 1)
+        if not ln.strip().startswith("#"):
+            # trailing comment on a code line: waives THAT line only —
+            # extending to the next statement would let an adjacent real
+            # violation ride a neighbor's waiver
+            continue
+        j = i + 1
+        while j < len(lines) and lines[j].strip().startswith("#"):
+            j += 1
+        if j < len(lines):
+            ok_lines.add(j + 1)
+    return ast.parse(src), lines, ok_lines
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """AST visitor tracking the enclosing def/class chain (for stable
+    ``where`` identifiers) and per-(scope, pattern) ordinals."""
+
+    def __init__(self, relpath: str, ok_lines):
+        self.relpath = relpath
+        self.ok_lines = ok_lines
+        self.stack: List[str] = []
+        self._ordinals: Dict[Tuple[str, str], int] = {}
+        self.hits: List[Tuple[str, str, int, str]] = []  # scope, pat, line
+
+    def scope(self) -> str:
+        return ".".join(self.stack) if self.stack else "<module>"
+
+    def record(self, pattern: str, node: ast.AST) -> None:
+        line = getattr(node, "lineno", 0)
+        if line in self.ok_lines:
+            return
+        scope = self.scope()
+        k = (scope, pattern)
+        i = self._ordinals.get(k, 0)
+        self._ordinals[k] = i + 1
+        self.hits.append((scope, f"{pattern}#{i}", line, pattern))
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+
+# --------------------------------------------------------------------------
+# baseline ratchet
+# --------------------------------------------------------------------------
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return {"schema": BASELINE_SCHEMA, "violations": {}}
+    with open(path) as fh:
+        data = json.load(fh)
+    if data.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(
+            f"baseline {path}: schema {data.get('schema')!r} != "
+            f"{BASELINE_SCHEMA} — regenerate with "
+            "`python -m proovread_tpu.analysis baseline`")
+    return data
+
+
+def save_baseline(violations: List[Violation],
+                  path: Optional[str] = None,
+                  notes: Optional[Dict[str, str]] = None) -> str:
+    """Rewrite the debt file from the current violation set (the
+    explicit 'accept current debts' action — never done implicitly)."""
+    path = path or DEFAULT_BASELINE
+    old = {}
+    if os.path.exists(path):
+        try:
+            old = load_baseline(path).get("violations", {})
+        except ValueError:
+            old = {}
+    vmap = {}
+    for v in sorted(violations, key=lambda v: v.key):
+        note = (notes or {}).get(v.key) or old.get(v.key) or v.message
+        vmap[v.key] = note
+    with open(path, "w") as fh:
+        json.dump({"schema": BASELINE_SCHEMA, "violations": vmap}, fh,
+                  indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def ratchet(violations: List[Violation],
+            baseline: Dict[str, Any]) -> Dict[str, Any]:
+    """Split violations against the committed debt file. ``new`` trips
+    the gate (rc 1); ``known`` is standing debt (reported, green);
+    ``resolved`` are baseline entries that no longer fire (the prompt to
+    ratchet the baseline down)."""
+    known_keys = baseline.get("violations", {})
+    new = [v for v in violations if v.key not in known_keys]
+    known = [v for v in violations if v.key in known_keys]
+    fired = {v.key for v in violations}
+    resolved = sorted(k for k in known_keys if k not in fired)
+    return {"new": new, "known": known, "resolved": resolved}

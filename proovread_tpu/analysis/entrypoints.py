@@ -1,0 +1,385 @@
+"""Registry of jitted/Pallas entry points for the rules sweep.
+
+One :class:`EntrySpec` per entry point the pipeline launches: the lazy
+getter returns the production wrapper (the ``@attributed`` jit object —
+``.trace``/``.lower`` are forwarded by ``obs/profile.py``), and
+``build_args`` yields SMALL abstract shapes (the same miniature geometry
+``tests/test_no_gather.py`` always traced at) — rule verdicts are
+shape-independent, so the sweep traces in seconds while the *census
+predictor* (``predict.py``) separately enumerates the real bucket-table
+shapes without tracing at all.
+
+``dead_args`` is the donation contract: positional argument indices
+whose buffers every production call site abandons after the call (the
+caller rebinds the name from the entry's output). The donation rule
+enforces the declaration BOTH ways — a declared-dead-but-undonated slab
+and a donated-but-undeclared argument are each violations — so this
+registry is forced to stay truthful about argument lifetimes.
+
+When a call-site signature changes, the reconciliation gate
+(``predict.py`` vs a recorded ledger) fails loudly; update the recipe
+here AND in ``predict.py``, then re-record if the zoo legitimately
+moved.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def sds(shape, dtype):
+    import jax
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+@dataclass
+class EntrySpec:
+    name: str
+    fn: Callable[[], Any]
+    build_args: Callable[[], Tuple[tuple, dict]]
+    chunk_scan: bool = False        # must contain a kernel-bearing scan
+    dead_args: Tuple[int, ...] = () # donation contract (see module doc)
+    check_donation: bool = True
+    notes: str = ""
+
+
+# -- shared miniature geometry (tests/test_no_gather.py's _small_args) -----
+
+class G:
+    B = 2
+    Lp = 256
+    S = 8
+    m = 128
+    CH = 128
+    n_chunks = 2
+    R = CH * n_chunks
+
+    @classmethod
+    def W(cls, ap=None):
+        from proovread_tpu.align import bsw
+        from proovread_tpu.align.params import AlignParams
+        return bsw.band_lanes(ap or AlignParams())
+
+    @classmethod
+    def n(cls):
+        return cls.m + cls.W()
+
+
+def _ap():
+    from proovread_tpu.align.params import AlignParams
+    return AlignParams()
+
+
+def _cns():
+    from proovread_tpu.consensus.params import ConsensusParams
+    return ConsensusParams(qual_weighted=False, use_ref_qual=True)
+
+
+def _consensus_call(B, L, K=6):
+    from proovread_tpu.ops.consensus_call import ConsensusCall
+    return ConsensusCall(
+        emitted=sds((B, L), np.bool_), base=sds((B, L), np.int8),
+        ins_len=sds((B, L), np.int32), ins_bases=sds((B, L, K), np.int8),
+        freq=sds((B, L), np.float32), phred=sds((B, L), np.int32),
+        coverage=sds((B, L), np.float32))
+
+
+def _pileup(B, L, K=6):
+    from proovread_tpu.ops.encode import N_STATES
+    from proovread_tpu.ops.pileup import Pileup
+    return Pileup(
+        counts=sds((B, L, N_STATES), np.float32),
+        ins_mbase=sds((B, L, N_STATES), np.float32),
+        ins_len_votes=sds((B, L, K), np.float32),
+        ins_base_votes=sds((B, L, K, 5), np.float32))
+
+
+# -- per-entry abstract argument builders ----------------------------------
+
+def _args_fused_pass():
+    B, Lp, S, m, CH, nc, R = G.B, G.Lp, G.S, G.m, G.CH, G.n_chunks, G.R
+    ap, cns, W = _ap(), _cns(), G.W()
+    qf = sds((S, m), np.int8)
+    args = (sds((B, Lp), np.int8), None, sds((B, Lp), np.int8),
+            sds((B, Lp), np.uint8), sds((B,), np.int32),
+            qf, qf, sds((S, m), np.uint8), sds((S,), np.int32),
+            sds((R,), np.int32), sds((R,), np.int8), sds((R,), np.int32),
+            sds((R,), np.int32), sds((), np.int32))
+    kw = dict(m=m, W=W, CH=CH, n_chunks=nc, ap=ap, cns=cns,
+              interpret=True, collect=False, budget_r=None, haplo=False)
+    return args, kw
+
+
+def _args_fused_iterations():
+    B, Lp, S, m, CH, nc = G.B, G.Lp, G.S, G.m, G.CH, G.n_chunks
+    ap, cns, W = _ap(), _cns(), G.W()
+    n_rest = 2
+    args = (sds((B, Lp), np.int8), sds((B, Lp), np.uint8),
+            sds((B,), np.int32), sds((B, Lp), np.bool_),
+            sds((), np.float32),
+            sds((S, m), np.int8), sds((S, m), np.int8),
+            sds((S, m), np.uint8), sds((S,), np.int32),
+            sds((n_rest, S), np.int32), sds((n_rest, 6), np.float32))
+    kw = dict(m=m, W=W, CH=CH, n_chunks=nc, ap=ap, cns=cns,
+              interpret=True, n_rest=n_rest, Lp=Lp, seed_stride=8,
+              seed_min_votes=2, shortcut_frac=0.92, min_gain=0.03)
+    return args, kw
+
+
+def _args_gather_and_align():
+    B, Lp, S, m, CH = G.B, G.Lp, G.S, G.m, G.CH
+    ap, W = _ap(), G.W()
+    args = (sds((B * Lp,), np.int8), sds((S, m), np.int8),
+            sds((S, m), np.int8), sds((S, m), np.uint8),
+            sds((S,), np.int32), sds((CH,), np.int32),
+            sds((CH,), np.int32), sds((CH,), np.int32),
+            sds((CH,), np.int32), Lp)
+    return args, dict(m=m, W=W, ap=ap, ignore_flat=None, interpret=True)
+
+
+def _args_bsw_expand():
+    m, CH = G.m, G.CH
+    ap, W = _ap(), G.W()
+    args = (sds((CH, m), np.int8), sds((CH, m + W), np.int8),
+            sds((CH,), np.int32), ap)
+    return args, dict(interpret=True)
+
+
+def _args_bsw_expand_v2():
+    from proovread_tpu.align import bsw
+    B, Lp, S, m, CH = G.B, G.Lp, G.S, G.m, G.CH
+    ap, W = _ap(), G.W()
+    padw = bsw.map_pad_width(m + W)
+    args = (sds((S, m), np.int8), sds((S, m), np.int8),
+            sds((B, Lp + 2 * padw), np.int8), sds((CH,), np.int32),
+            sds((CH,), np.int32), sds((CH,), np.int32),
+            sds((CH,), np.int32), sds((CH,), np.int32), ap)
+    return args, dict(interpret=True)
+
+
+def _args_pileup_accumulate():
+    from proovread_tpu.ops.votes import PACK_LANES
+    B, Lp, CH = G.B, G.Lp, G.CH
+    n = G.n()
+    Lpile = Lp + 2 * n
+    args = (sds((B, Lpile, PACK_LANES), np.float32),
+            sds((CH, n, PACK_LANES), np.float32),
+            sds((CH,), np.int32), sds((CH,), np.int32))
+    return args, dict(interpret=True)
+
+
+def _args_pileup_accumulate_packed():
+    from proovread_tpu.ops.votes import PACK_LANES
+    B, Lp, CH = G.B, G.Lp, G.CH
+    n = G.n()
+    Lpile = Lp + 2 * n
+    args = (sds((B, Lpile, PACK_LANES), np.float32),
+            sds((CH, n), np.int32),
+            sds((CH,), np.int32), sds((CH,), np.int32))
+    return args, dict(interpret=True)
+
+
+def _args_pileup_accumulate_bits():
+    from proovread_tpu.ops.votes import PACK_LANES
+    B, Lp, CH = G.B, G.Lp, G.CH
+    n = G.n()
+    Lpile = Lp + 2 * n
+    args = (sds((B, Lpile, 2 * PACK_LANES), np.dtype("bfloat16")),
+            sds((CH, n), np.int32), sds((CH, n), np.int32),
+            sds((CH,), np.int32), sds((CH,), np.int32))
+    return args, dict(interpret=True)
+
+
+def _args_assemble_rows():
+    B, Lp = G.B, G.Lp
+    return ((_consensus_call(B, Lp), sds((B,), np.int32), Lp),
+            dict(interpret=True))
+
+
+def _args_hcr_mask_rows():
+    B, Lp = G.B, G.Lp
+    return ((sds((B, Lp), np.uint8), sds((B,), np.int32),
+             sds((6,), np.float32)), dict(interpret=True))
+
+
+def _args_call_consensus():
+    B, Lp = G.B, G.Lp
+    return ((_pileup(B, Lp), sds((B, Lp), np.int8)),
+            dict(max_ins_length=0))
+
+
+def _args_fused_accumulate():
+    B, Lp, CH, m = G.B, G.Lp, G.CH, G.m
+    T = 64
+    args = (_pileup(B, Lp), sds((CH, T), np.int8), sds((CH, T), np.int16),
+            sds((CH, T), np.int16), sds((CH, m), np.int8),
+            sds((CH, m), np.uint8), sds((CH,), np.int32),
+            sds((CH,), np.int32), sds((CH,), np.int32),
+            sds((CH,), np.int32), sds((CH,), np.bool_))
+    return args, dict(qual_weighted=False)
+
+
+def _args_add_ref_votes():
+    B, Lp = G.B, G.Lp
+    return ((_pileup(B, Lp), sds((B, Lp), np.int8),
+             sds((B, Lp), np.float32), sds((B, Lp), np.float32)), {})
+
+
+def _args_device_admit():
+    B, R = G.B, G.R
+    args = (sds((R,), np.int32), sds((R,), np.int32), sds((R,), np.int32),
+            sds((R,), np.float32), sds((R,), np.bool_),
+            sds((B,), np.int32))
+    return args, dict(params=_cns(), budget_r=None)
+
+
+def _get_device_index():
+    """device_index is a plain builder over the jitted ``build_index`` —
+    jit it whole so the rules sweep sees the full seeding program."""
+    import jax
+    from proovread_tpu.align import dseed
+    return jax.jit(dseed.device_index, static_argnames=("k",))
+
+
+def _args_device_index():
+    B, Lp = G.B, G.Lp
+    return ((sds((B, Lp), np.int8), sds((B,), np.int32)), dict(k=12))
+
+
+def _args_probe():
+    """``probe_candidates``'s jitted core (the public wrapper only
+    unpacks statics a NamedTuple jit could not carry)."""
+    from proovread_tpu.align.dseed import TABLE_BASES
+    B, Lp, S, m = G.B, G.Lp, G.S, G.m
+    ap = _ap()
+    k = ap.min_seed_len
+    M = B * Lp
+    T = (1 << (2 * TABLE_BASES)) if k >= TABLE_BASES else (1 << (2 * k))
+    args = (sds((M,), np.uint32), sds((M,), np.int32),
+            sds((T + 1,), np.int32), sds((T + 1,), np.int32),
+            sds((S, m), np.int8), sds((S,), np.int32), sds((S, m), np.int8))
+    kw = dict(k=k, L=Lp, stride=8, occ_cap=4, slots=ap.max_candidates,
+              quant=max(ap.band_width // 2, 1), max_occ=ap.max_occ,
+              min_votes=2, shift=2 * max(k - TABLE_BASES, 0), slab=16384)
+    return args, kw
+
+
+def _args_compact_candidates():
+    from proovread_tpu.align.dseed import DeviceCandidates
+    S = G.S
+    cand = DeviceCandidates(lread=sds((S, 2, 8), np.int32),
+                            diag=sds((S, 2, 8), np.int32),
+                            votes=sds((S, 2, 8), np.int32))
+    return (cand,), {}
+
+
+def _get_dmesh_step():
+    """The dmesh compile chokepoint at its smallest real configuration:
+    a 1-device mesh step built through ``build_sharded_step`` (the same
+    code path every mesh shape takes)."""
+    import jax
+    from proovread_tpu.parallel import dmesh
+    mesh = dmesh.make_dp_mesh(1)
+    return dmesh.build_sharded_step(
+        mesh, _ap(), _cns(), chunks_per_shard=G.n_chunks, chunk=G.CH,
+        seed_stride=8, seed_min_votes=2, interpret=True)
+
+
+def _args_dmesh_step():
+    B, Lp, S, m = G.B, G.Lp, G.S, G.m
+    args = (sds((B, Lp), np.int8), sds((B, Lp), np.uint8),
+            sds((B,), np.int32), sds((B, Lp), np.bool_),
+            sds((B,), np.bool_), sds((S, m), np.int8),
+            sds((S, m), np.int8), sds((S, m), np.uint8),
+            sds((S,), np.int32), sds((6,), np.float32))
+    return args, {}
+
+
+def _lazy(path: str, attr: str):
+    def get():
+        import importlib
+        return getattr(importlib.import_module(path), attr)
+    return get
+
+
+def registry() -> List[EntrySpec]:
+    dc = "proovread_tpu.pipeline.dcorrect"
+    return [
+        EntrySpec("fused_pass", _lazy(dc, "_fused_pass"),
+                  _args_fused_pass, chunk_scan=True,
+                  notes="args 0/2 may alias (map=codes when unmasked) and "
+                        "codes/qual feed QC after the call — not dead"),
+        EntrySpec("fused_iterations", _lazy(dc, "fused_iterations"),
+                  _args_fused_iterations, chunk_scan=True,
+                  dead_args=(0, 1, 2, 3),
+                  notes="driver rebinds codes/qual/lengths/mask from the "
+                        "output; the input state slabs are dead"),
+        EntrySpec("gather_and_align", _lazy(dc, "_gather_and_align"),
+                  _args_gather_and_align),
+        EntrySpec("bsw_expand",
+                  _lazy("proovread_tpu.align.bsw", "bsw_expand"),
+                  _args_bsw_expand),
+        EntrySpec("bsw_expand_v2",
+                  _lazy("proovread_tpu.align.bsw", "bsw_expand_v2"),
+                  _args_bsw_expand_v2),
+        EntrySpec("pileup_accumulate",
+                  _lazy("proovread_tpu.ops.pileup_kernel",
+                        "pileup_accumulate"),
+                  _args_pileup_accumulate,
+                  notes="accumulator is the scan CARRY inside the fused "
+                        "program (jit-boundary donation is dead code "
+                        "there) and the kernel-equivalence oracles reuse "
+                        "the zero buffer across calls — not declared "
+                        "dead"),
+        EntrySpec("pileup_accumulate_packed",
+                  _lazy("proovread_tpu.ops.pileup_kernel",
+                        "pileup_accumulate_packed"),
+                  _args_pileup_accumulate_packed,
+                  notes="see pileup_accumulate"),
+        EntrySpec("pileup_accumulate_bits",
+                  _lazy("proovread_tpu.ops.pileup_kernel",
+                        "pileup_accumulate_bits"),
+                  _args_pileup_accumulate_bits,
+                  notes="see pileup_accumulate"),
+        EntrySpec("assemble_rows",
+                  _lazy("proovread_tpu.ops.assemble_kernel",
+                        "assemble_rows"),
+                  _args_assemble_rows,
+                  notes="`call` feeds QC/chimera after assembly — live"),
+        EntrySpec("hcr_mask_rows",
+                  _lazy("proovread_tpu.ops.assemble_kernel",
+                        "hcr_mask_rows"),
+                  _args_hcr_mask_rows),
+        EntrySpec("call_consensus",
+                  _lazy("proovread_tpu.ops.consensus_call",
+                        "call_consensus"),
+                  _args_call_consensus),
+        EntrySpec("fused_accumulate",
+                  _lazy("proovread_tpu.ops.fused", "fused_accumulate"),
+                  _args_fused_accumulate, dead_args=(0,),
+                  notes="accumulator carry — donated since the host fused "
+                        "stack landed; the rule now pins it"),
+        EntrySpec("add_ref_votes",
+                  _lazy("proovread_tpu.ops.fused", "add_ref_votes"),
+                  _args_add_ref_votes,
+                  notes="pile is rebuilt functionally (_replace) but the "
+                        "caller keeps `pile.counts` subtraction inputs "
+                        "live in the haplo path — not declared dead"),
+        EntrySpec("device_admit", _lazy(dc, "device_admit"),
+                  _args_device_admit),
+        EntrySpec("device_index", _get_device_index, _args_device_index),
+        EntrySpec("probe_candidates",
+                  _lazy("proovread_tpu.align.dseed", "_probe"),
+                  _args_probe),
+        EntrySpec("compact_candidates",
+                  _lazy("proovread_tpu.align.dseed", "compact_candidates"),
+                  _args_compact_candidates),
+        EntrySpec("dmesh:step", _get_dmesh_step, _args_dmesh_step,
+                  chunk_scan=True, dead_args=(0, 1, 2, 3),
+                  notes="the compile chokepoint; the driver's mesh loop "
+                        "rebinds the sharded state from each step's "
+                        "output (row_valid/query slabs stay live)"),
+    ]

@@ -1,0 +1,340 @@
+"""Compile-key zoo predictor: enumerate every (entry, signature) program.
+
+For a bench config, this module enumerates the distinct XLA programs
+each attributed entry point can compile — WITHOUT tracing or running
+anything. A "program" is identified exactly as the compile ledger
+identifies it: ``obs/compilecache.py:signature(args, kwargs)`` over the
+call's abstract (shape/dtype) args and static values. The predictor
+builds the same argument trees the production call sites build (leaves
+as ``ShapeDtypeStruct`` — ``signature``'s ``_spec`` maps real arrays and
+specs to identical reprs) and hashes them with the SAME function, so a
+predicted signature is bit-equal to the ledger row a real run at those
+shapes would record.
+
+Data-dependent statics (the chunk-ladder value sized from the live
+candidate count, the sampler's slab sizes) are enumerated over their
+full structural range — the prediction is a SUPERSET by construction,
+and :func:`reconcile` proves it against a recorded ledger:
+``predicted ⊇ observed`` is the honesty gate (a missed signature means
+the oracle lost track of a call site — the gate fails and itemizes it),
+while predicted-but-never-observed classes are reported as stale-budget
+candidates (``make compile-check`` cross-links them).
+
+Scope and declared blind spots (all itemized, never silent):
+
+- Only *top-level* attributed calls appear in a ledger census (calls
+  inside another trace are owned by the outer program) — the predictor
+  models exactly those: ``fused_pass``, ``fused_iterations``,
+  ``assemble_rows``.
+- ``dmesh:*`` entries salt their signatures per compilation
+  (``compile_step_with_plan``), so cross-process signature equality is
+  impossible by design; reconciliation falls back to per-entry COUNT
+  comparison for salted entries.
+- Predictions assume a clean run: demoted resilience-ladder rungs and
+  QC-on runs (``collect_qc=True``) compile parallel variants outside
+  this budget (docs/STATIC_ANALYSIS.md).
+
+The per-entry **budget** (``analysis/budget.json``) is the ratchet over
+the predicted counts: growth fails ``make static-check``; shrinkage is
+reported so the budget can be ratcheted down (ROADMAP item 1's
+consolidation refactor banks its wins here).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from proovread_tpu.analysis.shapes import (Bucket, ConfigPlan, build_plan,
+                                           candidate_chunk_bound,
+                                           chunk_ladder)
+
+PREDICT_SCHEMA = 1
+DEFAULT_BUDGET = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "budget.json")
+
+
+def _sds(shape, dtype):
+    import jax
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+# --------------------------------------------------------------------------
+# call-site recipes — each mirrors ONE production call site's argument
+# construction; the reconciliation gate is what keeps them honest
+# --------------------------------------------------------------------------
+
+def _recipe_fused_pass(plan: ConfigPlan, b: Bucket, interpret: bool):
+    """``DeviceCorrector.correct_pass`` -> ``_fused_pass``: the eager
+    pass-1 (iteration params, collect=False) and the finish pass
+    (finish params, collect=True for the chimera scan). The chunk count
+    is data-sized — enumerate the ladder to the structural candidate
+    bound."""
+    from proovread_tpu.align import bsw
+    from proovread_tpu.pipeline.driver import (_align_params_cfg,
+                                               finish_consensus_params,
+                                               iteration_consensus_params)
+    pc = plan.pc
+    CH = pc.device_chunk
+    passes = [
+        (_align_params_cfg(pc, 1),
+         iteration_consensus_params(pc, plan.coverage), False,
+         plan.S_variants()),
+        (_align_params_cfg(pc, None),
+         finish_consensus_params(pc, plan.coverage), True,
+         plan.S_variants()),
+    ]
+    for ap, cns, collect, S_list in passes:
+        W = bsw.band_lanes(ap)
+        for S in S_list:
+            for nc in chunk_ladder(candidate_chunk_bound(S, ap, CH)):
+                R = nc * CH
+                qslab = _sds((S, plan.m), np.int8)
+                args = (_sds((b.rows, b.Lp), np.int8), None,
+                        _sds((b.rows, b.Lp), np.int8),
+                        _sds((b.rows, b.Lp), np.uint8),
+                        _sds((b.rows,), np.int32),
+                        qslab, qslab, _sds((S, plan.m), np.uint8),
+                        _sds((S,), np.int32),
+                        _sds((R,), np.int32), _sds((R,), np.int8),
+                        _sds((R,), np.int32), _sds((R,), np.int32),
+                        _sds((), np.int32))
+                kw = dict(m=plan.m, W=W, CH=CH, n_chunks=nc, ap=ap,
+                          cns=cns, interpret=interpret, collect=collect,
+                          budget_r=None, haplo=False)
+                yield "fused_pass", args, kw
+
+
+def _recipe_fused_iterations(plan: ConfigPlan, b: Bucket, interpret: bool):
+    """The driver's fused remainder (passes 2..n as one program). The
+    sampler decides full-set vs sampled slabs; the static chunk count is
+    capped by the structural 2-per-sampled-read bound and shrunk by
+    pass-1's observed candidate count — enumerate the whole reachable
+    ladder."""
+    from proovread_tpu.align import bsw
+    from proovread_tpu.pipeline.dcorrect import _bucket_chunks
+    from proovread_tpu.pipeline.driver import (_align_params_cfg,
+                                               iteration_consensus_params)
+    pc = plan.pc
+    CH = pc.device_chunk
+    n_fused = pc.n_iterations - 1          # first_fused == 2 on clean runs
+    if n_fused <= 0:
+        return
+    ap = _align_params_cfg(pc, 2)
+    cns = iteration_consensus_params(pc, plan.coverage)
+    W = bsw.band_lanes(ap)
+    S = plan.S_full
+    can_sample = plan.coverage * 0.8 >= pc.sr_coverage
+    # (full_set, sels columns, the driver's Rsel chunk-cap input). The
+    # full-set variant always stays reachable (deep-enough coverage can
+    # still select every chunk when cps >= chunk_step); under sampling
+    # the driver sizes BOTH sels and the cap from the 512-rounded max
+    # *sampled* selection length, which rotates per pass — enumerate
+    # every 512-multiple, like S_variants does for fused_pass
+    sel_variants: List[Tuple[bool, int, int]] = [(True, 1, plan.rsel())]
+    if can_sample:
+        sel_variants += [(False, k, k) for k in plan.sampled_S()]
+    for full_set, sel_cols, rsel in sel_variants:
+        cap = max(1, -(-2 * rsel // CH))
+        for nc in chunk_ladder(_bucket_chunks(cap)):
+            args = (_sds((b.rows, b.Lp), np.int8),
+                    _sds((b.rows, b.Lp), np.uint8),
+                    _sds((b.rows,), np.int32),
+                    _sds((b.rows, b.Lp), np.bool_),
+                    _sds((), np.float32),
+                    _sds((S, plan.m), np.int8), _sds((S, plan.m), np.int8),
+                    _sds((S, plan.m), np.uint8), _sds((S,), np.int32),
+                    _sds((n_fused, sel_cols), np.int32),
+                    _sds((n_fused, 6), np.float32))
+            kw = dict(m=plan.m, W=W, CH=CH, n_chunks=nc, ap=ap, cns=cns,
+                      interpret=interpret, n_rest=n_fused, Lp=b.Lp,
+                      seed_stride=pc.seed_stride, seed_min_votes=2,
+                      shortcut_frac=pc.mask_shortcut_frac,
+                      min_gain=pc.mask_min_gain_frac, full_set=full_set,
+                      collect_qc=False)
+            yield "fused_iterations", args, kw
+
+
+def _recipe_assemble_rows(plan: ConfigPlan, b: Bucket, interpret: bool):
+    """``device_assemble`` at the driver level (after pass 1 and in the
+    finish fetch) — one program per bucket shape."""
+    from proovread_tpu.ops.consensus_call import ConsensusCall
+    from proovread_tpu.ops.votes import INS_CAP
+    call = ConsensusCall(
+        emitted=_sds((b.rows, b.Lp), np.bool_),
+        base=_sds((b.rows, b.Lp), np.int8),
+        ins_len=_sds((b.rows, b.Lp), np.int32),
+        ins_bases=_sds((b.rows, b.Lp, INS_CAP), np.int8),
+        freq=_sds((b.rows, b.Lp), np.float32),
+        phred=_sds((b.rows, b.Lp), np.int32),
+        coverage=_sds((b.rows, b.Lp), np.float32))
+    yield "assemble_rows", (call, _sds((b.rows,), np.int32), b.Lp), \
+        dict(interpret=interpret)
+
+
+RECIPES = (_recipe_fused_pass, _recipe_fused_iterations,
+           _recipe_assemble_rows)
+
+
+# --------------------------------------------------------------------------
+# prediction + gates
+# --------------------------------------------------------------------------
+
+def predict_config(config: int, cap_bases: Optional[int] = None,
+                   interpret: bool = True,
+                   plan: Optional[ConfigPlan] = None) -> Dict[str, Any]:
+    """The predicted census for one config: ``programs`` maps every
+    modeled entry to its sorted signature set."""
+    from proovread_tpu.obs import compilecache
+    if plan is None:
+        plan = build_plan(config, cap_bases)
+    programs: Dict[str, set] = {}
+    for b in plan.buckets:
+        for recipe in RECIPES:
+            for entry, args, kw in recipe(plan, b, interpret):
+                programs.setdefault(entry, set()).add(
+                    compilecache.signature(args, kw))
+    return {
+        "schema": PREDICT_SCHEMA,
+        "config": plan.config,
+        "cap_bases": plan.cap_bases,
+        "interpret": interpret,
+        "plan": {
+            "n_short": plan.n_short, "m": plan.m,
+            "coverage": round(plan.coverage, 4),
+            "buckets": [{"n_reads": b.n_reads, "rows": b.rows,
+                         "Lp": b.Lp, "pad": b.pad}
+                        for b in plan.buckets],
+        },
+        "programs": {e: sorted(s) for e, s in sorted(programs.items())},
+        "by_entry": {e: len(s) for e, s in sorted(programs.items())},
+        "n_programs": sum(len(s) for s in programs.values()),
+    }
+
+
+def ledger_backend(path: str) -> str:
+    """The backend a compile-ledger artifact was recorded on (its meta
+    line). Reconciliation must predict with the matching ``interpret``
+    static — the flag is part of every program's compile key, so a TPU
+    ledger (interpret=False) can never reconcile against a CPU-flavored
+    prediction."""
+    with open(path) as fh:
+        meta = json.loads(next(fh))
+    return meta.get("backend") or "cpu"
+
+
+def interpret_for_backend(backend: str) -> bool:
+    """Mirror of ``bsw.default_interpret()`` without initializing jax:
+    Pallas interpret mode everywhere except a real TPU."""
+    return backend != "tpu"
+
+
+def load_ledger_programs(path: str) -> Dict[str, List[str]]:
+    """Observed (entry -> signatures) from a compile-ledger JSONL
+    artifact (``--compile-ledger``): the ``retrace`` rows are the
+    tracing-cache misses — exactly the census's distinct programs."""
+    from proovread_tpu.obs.validate import validate_compile_ledger
+    validate_compile_ledger(path)           # strict schema first
+    out: Dict[str, List[str]] = {}
+    with open(path) as fh:
+        next(fh)                            # meta line
+        for line in fh:
+            row = json.loads(line)
+            if row.get("kind") == "retrace":
+                out.setdefault(row["entry"], []).append(row["sig"])
+    return {e: sorted(set(s)) for e, s in out.items()}
+
+
+def reconcile(predicted: Dict[str, Any],
+              observed: Dict[str, List[str]]) -> Dict[str, Any]:
+    """``predicted ⊇ observed``, itemized.
+
+    Signature-level comparison for plain entries; count-level for salted
+    (``dmesh:``-style, name contains ``:``) entries whose signatures are
+    per-process by design. ``missing`` (observed but not predicted)
+    fails the gate; ``unobserved`` (predicted but never seen) feeds the
+    stale-budget report."""
+    missing: List[Dict[str, Any]] = []
+    unobserved: Dict[str, int] = {}
+    pred = predicted["programs"]
+    for entry, sigs in sorted(observed.items()):
+        if ":" in entry:
+            have = len(pred.get(entry, []))
+            if have < len(sigs):
+                missing.append({"entry": entry, "kind": "count",
+                                "observed": len(sigs), "predicted": have})
+            continue
+        psigs = set(pred.get(entry, []))
+        for s in sigs:
+            if s not in psigs:
+                missing.append({"entry": entry, "kind": "signature",
+                                "sig": s})
+    for entry, sigs in pred.items():
+        seen = set(observed.get(entry, []))
+        extra = [s for s in sigs if s not in seen]
+        if extra:
+            unobserved[entry] = len(extra)
+    return {"ok": not missing, "missing": missing,
+            "unobserved": unobserved,
+            "observed_entries": sorted(observed),
+            "unmodeled": sorted(e for e in observed
+                                if e not in pred and ":" not in e
+                                and e != "(unattributed)")}
+
+
+def load_budget(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or DEFAULT_BUDGET
+    if not os.path.exists(path):
+        return {"schema": PREDICT_SCHEMA, "budgets": {}}
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def save_budget(per_config: Dict[str, Dict[str, int]],
+                path: Optional[str] = None) -> str:
+    path = path or DEFAULT_BUDGET
+    with open(path, "w") as fh:
+        json.dump({"schema": PREDICT_SCHEMA, "budgets": per_config}, fh,
+                  indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def budget_check(predicted: Dict[str, Any],
+                 budget: Dict[str, Any]) -> Dict[str, Any]:
+    """The program-budget ratchet: per entry, predicted count vs the
+    committed ceiling. Growth = breach (rc 1); a NEW entry point with no
+    budget line is also a breach (every program class must be budgeted);
+    shrinkage is reported so the budget ratchets down."""
+    key = f"config{predicted['config']}"
+    ceilings = budget.get("budgets", {}).get(key)
+    if ceilings is None:
+        return {"ok": False, "pool": key,
+                "breaches": [{"entry": "(pool)", "predicted":
+                              predicted["n_programs"], "budget": None,
+                              "note": f"no committed budget for {key} — "
+                              "run `python -m proovread_tpu.analysis "
+                              "budget` and commit it"}],
+                "shrinkable": {}}
+    breaches = []
+    shrinkable = {}
+    for entry, n in predicted["by_entry"].items():
+        cap = ceilings.get(entry)
+        if cap is None:
+            breaches.append({"entry": entry, "predicted": n,
+                             "budget": None,
+                             "note": "new entry point with no budget "
+                                     "line"})
+        elif n > cap:
+            breaches.append({"entry": entry, "predicted": n,
+                             "budget": cap})
+        elif n < cap:
+            shrinkable[entry] = {"predicted": n, "budget": cap}
+    for entry, cap in ceilings.items():
+        if entry not in predicted["by_entry"]:
+            shrinkable[entry] = {"predicted": 0, "budget": cap}
+    return {"ok": not breaches, "pool": key, "breaches": breaches,
+            "shrinkable": shrinkable}

@@ -1,0 +1,326 @@
+"""Built-in program-contract rules (docs/STATIC_ANALYSIS.md).
+
+Jaxpr rules (run over every traced registry entry):
+
+- ``no-gather``     — kernel-bearing chunk-scan bodies contain zero XLA
+  ``gather`` equations (the PR 7 property, promoted from
+  tests/test_no_gather.py). Entries declaring ``chunk_scan=True`` must
+  HAVE such a scan — a fused path that changed shape fails loudly.
+- ``donation``      — every argument the registry declares dead-after-
+  call (``EntrySpec.dead_args``) is donated, and every donated argument
+  is declared: the lowering's ``args_info`` is checked both ways, so the
+  registry's lifetime declarations and the jit's ``donate_argnums`` can
+  never drift apart (SNIPPETS.md [1] ``donation_vector`` — the lever
+  ROADMAP item 1 names for the big slabs).
+- ``host-sync``     — no callback primitives (``pure_callback`` /
+  ``io_callback`` / ``debug_callback``) anywhere in a traced program,
+  and no ``device_put`` transfers inside a chunk-scan body (a per-chunk
+  host→device upload is a dispatch stall per chunk).
+- ``wide-dtype``    — no f64/i64/u64/c128 values anywhere in a traced
+  program (an x64 leak doubles slab bytes and falls off the TPU fast
+  path).
+- ``packed-upcast`` — no large (u)int8/uint32→float32/float64
+  ``convert_element_type`` inside a chunk-scan body: the packed code /
+  vote-word arrays are the bandwidth discipline of the hot loop; a
+  silent f32 widening there costs 4x HBM traffic per chunk.
+
+AST rules (run over source files, ``test_no_naked_timers`` style):
+
+- ``naked-timer``   — no bare ``time.time()`` in pipeline/, obs/ or the
+  CLI (the one-clock invariant of the span tracer).
+- ``host-sync-ast`` — in the declared hot-path functions, no
+  ``.item()`` / ``np.asarray`` / ``np.array`` / ``jax.device_get`` /
+  ``int()``/``float()``/``bool()`` coercions of computed values: each is
+  a blocking device→host sync when its operand is traced or device-
+  resident. Sites that are host-side by construction carry an inline
+  ``# static-ok: <reason>``; real-but-accepted syncs (the documented
+  n_cand fetch) live in the baseline as standing debt.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+import numpy as np
+
+from proovread_tpu.analysis.engine import (ScopedVisitor, Violation,
+                                           ast_rule, jaxpr_rule,
+                                           kernel_scan_bodies,
+                                           parse_module, walk)
+
+# --------------------------------------------------------------------------
+# jaxpr rules
+# --------------------------------------------------------------------------
+
+
+@jaxpr_rule("no-gather")
+def rule_no_gather(spec, traced) -> List[Violation]:
+    bodies = kernel_scan_bodies(traced.closed)
+    out: List[Violation] = []
+    if spec.chunk_scan and not bodies:
+        out.append(Violation(
+            "no-gather", f"entry:{spec.name}", "no-chunk-scan",
+            "no kernel-bearing chunk scan found — the fused path changed "
+            "shape; update the entry registry, don't delete the rule"))
+        return out
+    for bi, body in enumerate(bodies):
+        gathers = [e for e in walk(body) if e.primitive.name == "gather"]
+        if gathers:
+            out.append(Violation(
+                "no-gather", f"entry:{spec.name}", f"scan{bi}",
+                f"{len(gathers)} XLA gather op(s) inside a chunk scan "
+                f"(first: {gathers[0]}). Per-chunk gathers run at "
+                "~10 ns/element on the TPU scalar core — route the access "
+                "through the bsw v2 kernel's DMA path (PERF.md attack "
+                "plan #2)"))
+    return out
+
+
+@jaxpr_rule("donation")
+def rule_donation(spec, traced) -> List[Violation]:
+    if not spec.check_donation:
+        return []
+    import jax
+    args_info, kw_info = traced.lowered().args_info
+    out: List[Violation] = []
+    for idx, info in enumerate(args_info):
+        leaves = jax.tree_util.tree_leaves(info)
+        if not leaves:
+            continue
+        donated = all(l.donated for l in leaves)
+        part = any(l.donated for l in leaves)
+        declared = idx in spec.dead_args
+        if declared and not donated:
+            def _leaf_bytes(l):
+                aval = getattr(l, "aval", None) or getattr(l, "_aval",
+                                                           None)
+                itemsize = (np.dtype(aval.dtype).itemsize
+                            if aval is not None else 1)
+                return int(np.prod(l.shape)) * itemsize
+            nbytes = sum(_leaf_bytes(l) for l in leaves)
+            out.append(Violation(
+                "donation", f"entry:{spec.name}", f"arg{idx}-undonated",
+                f"argument {idx} is declared dead after the call but not "
+                f"donated (donate_argnums) — the slab ({nbytes}B at trace "
+                "shape, scales with the bucket) is held live across the "
+                "call for nothing"))
+        elif part and not declared:
+            out.append(Violation(
+                "donation", f"entry:{spec.name}", f"arg{idx}-undeclared",
+                f"argument {idx} is donated but the entry registry does "
+                "not declare it dead-after-call — declare the lifetime in "
+                "analysis/entrypoints.py so callers can be audited"))
+    return out
+
+
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "python_callback", "callback", "host_callback_call"}
+
+
+@jaxpr_rule("host-sync")
+def rule_host_sync_jaxpr(spec, traced) -> List[Violation]:
+    out: List[Violation] = []
+    cbs = {}
+    for e in walk(traced.closed.jaxpr):
+        if e.primitive.name in _CALLBACK_PRIMS:
+            cbs[e.primitive.name] = cbs.get(e.primitive.name, 0) + 1
+    for name, n in sorted(cbs.items()):
+        out.append(Violation(
+            "host-sync", f"entry:{spec.name}", f"callback:{name}",
+            f"{n} {name} equation(s) — a host callback inside a traced "
+            "program stalls the device pipeline on every call"))
+    for bi, body in enumerate(kernel_scan_bodies(traced.closed)):
+        puts = [e for e in walk(body) if e.primitive.name == "device_put"]
+        if puts:
+            out.append(Violation(
+                "host-sync", f"entry:{spec.name}", f"scan{bi}-device_put",
+                f"{len(puts)} device_put transfer(s) inside a chunk scan "
+                "— hoist the upload out of the per-chunk loop"))
+    return out
+
+
+_WIDE = {np.dtype(np.float64), np.dtype(np.int64), np.dtype(np.uint64),
+         np.dtype(np.complex128)}
+
+
+@jaxpr_rule("wide-dtype")
+def rule_wide_dtype(spec, traced) -> List[Violation]:
+    seen = {}
+    for e in walk(traced.closed.jaxpr):
+        for v in list(e.outvars):
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None and np.dtype(dt) in _WIDE:
+                key = (e.primitive.name, str(np.dtype(dt)))
+                seen[key] = seen.get(key, 0) + 1
+    return [Violation(
+        "wide-dtype", f"entry:{spec.name}", f"{prim}->{dt}",
+        f"{n} equation(s) produce {dt} ({prim}) — an x64 leak doubles "
+        "slab bytes and leaves the TPU fast path")
+        for (prim, dt), n in sorted(seen.items())]
+
+
+_PACKED_SRC = {np.dtype(np.int8), np.dtype(np.uint8), np.dtype(np.uint32)}
+_WIDE_DST = {np.dtype(np.float32), np.dtype(np.float64)}
+PACKED_UPCAST_MIN_ELEMS = 4096
+
+
+@jaxpr_rule("packed-upcast")
+def rule_packed_upcast(spec, traced) -> List[Violation]:
+    out: List[Violation] = []
+    for bi, body in enumerate(kernel_scan_bodies(traced.closed)):
+        hits = 0
+        for e in walk(body):
+            if e.primitive.name != "convert_element_type":
+                continue
+            src = getattr(getattr(e.invars[0], "aval", None), "dtype", None)
+            dst = getattr(getattr(e.outvars[0], "aval", None), "dtype", None)
+            shape = getattr(getattr(e.invars[0], "aval", None), "shape", ())
+            if (src is not None and dst is not None
+                    and np.dtype(src) in _PACKED_SRC
+                    and np.dtype(dst) in _WIDE_DST
+                    and int(np.prod(shape or (1,)))
+                    >= PACKED_UPCAST_MIN_ELEMS):
+                hits += 1
+        if hits:
+            out.append(Violation(
+                "packed-upcast", f"entry:{spec.name}", f"scan{bi}",
+                f"{hits} large (u)int8/u32→f32 convert(s) inside a chunk "
+                "scan — widening packed code/vote arrays costs 4x HBM "
+                "traffic per chunk; keep the packed representation to the "
+                "kernel boundary"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# AST rules
+# --------------------------------------------------------------------------
+
+# scope of the naked-timer rule — the same directories
+# tests/test_obs.py::test_no_naked_timers always scanned
+NAKED_TIMER_SCOPE = ("pipeline", "obs", "cli.py")
+
+
+class _NakedTimerVisitor(ScopedVisitor):
+    def visit_Call(self, node):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "time"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"):
+            self.record("time.time()", node)
+        self.generic_visit(node)
+
+
+@ast_rule("naked-timer")
+def rule_naked_timer(root: str) -> List[Violation]:
+    """Every duration must come from the tracer's monotonic clock: a
+    bare ``time.time()`` breaks the one-clock-one-schema invariant
+    (PR 3 satellite, promoted from tests/test_obs.py)."""
+    out: List[Violation] = []
+    for target in NAKED_TIMER_SCOPE:
+        tpath = os.path.join(root, target)
+        files = ([tpath] if tpath.endswith(".py") else
+                 sorted(os.path.join(tpath, f) for f in os.listdir(tpath)
+                        if f.endswith(".py")))
+        for path in files:
+            rel = os.path.relpath(path, root)
+            tree, _lines, ok_lines = parse_module(path)
+            v = _NakedTimerVisitor(rel, ok_lines)
+            v.visit(tree)
+            out.extend(Violation(
+                "naked-timer", f"{rel}::{scope}", detail,
+                f"bare time.time() at {rel}:{line} — use obs.span / "
+                "time.monotonic()")
+                for scope, detail, line, _pat in v.hits)
+    return out
+
+
+# hot-path host-sync scope: module relpath -> function/method names to
+# scan (qualified by def-chain), or None for every function in the file.
+# These are the functions that run per pass / per chunk on the device
+# path; host-side plumbing in the same modules is deliberately excluded.
+HOST_SYNC_SCOPE = {
+    "pipeline/dcorrect.py": [
+        "DeviceCorrector.correct_pass", "_fused_pass_scanned",
+        "_fused_pass_unrolled", "_fused_pass_body", "fused_iterations",
+        "_gather_and_align", "device_assemble", "device_hcr_mask_dyn",
+        "device_admit", "_pad_candidates"],
+    "parallel/dmesh.py": [
+        "compile_step_with_plan", "build_sharded_step",
+        "sharded_iteration_step"],
+    "align/bsw.py": ["bsw_expand", "bsw_expand_v2", "build_map_pad",
+                     "window_starts"],
+    "align/dseed.py": ["device_index", "probe_candidates",
+                       "compact_candidates", "_probe"],
+    "ops/pileup_kernel.py": ["pileup_accumulate",
+                             "pileup_accumulate_packed",
+                             "pileup_accumulate_bits"],
+    "ops/assemble_kernel.py": ["assemble_rows", "hcr_mask_rows"],
+    "ops/fused.py": ["fused_accumulate", "add_ref_votes"],
+    "ops/consensus_call.py": ["call_consensus"],
+}
+
+
+class _HostSyncVisitor(ScopedVisitor):
+    """Flags blocking device→host syncs / host→device round trips in the
+    hot-path functions. ``int()``/``float()``/``bool()`` are flagged only
+    for computed operands (a Name/Attribute/Call argument); literals and
+    ``len()`` are host arithmetic."""
+
+    def __init__(self, relpath, ok_lines, fn_filter):
+        super().__init__(relpath, ok_lines)
+        self.fn_filter = fn_filter
+
+    def in_scope(self) -> bool:
+        if self.fn_filter is None:
+            return bool(self.stack)
+        scope = self.scope()
+        return any(scope == f or scope.startswith(f + ".")
+                   for f in self.fn_filter)
+
+    def visit_Call(self, node):
+        if self.in_scope():
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "item" and not node.args:
+                    self.record(".item()", node)
+                elif (f.attr in ("asarray", "array")
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "np"):
+                    self.record(f"np.{f.attr}()", node)
+                elif f.attr == "device_get":
+                    self.record("device_get()", node)
+            elif (isinstance(f, ast.Name) and f.id in ("int", "float",
+                                                       "bool")
+                    and len(node.args) == 1
+                    and isinstance(node.args[0],
+                                   (ast.Name, ast.Attribute, ast.Call))
+                    and not (isinstance(node.args[0], ast.Call)
+                             and isinstance(node.args[0].func, ast.Name)
+                             and node.args[0].func.id == "len")):
+                self.record(f"{f.id}()", node)
+        self.generic_visit(node)
+
+
+@ast_rule("host-sync-ast")
+def rule_host_sync_ast(root: str) -> List[Violation]:
+    out: List[Violation] = []
+    for rel, fns in sorted(HOST_SYNC_SCOPE.items()):
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            out.append(Violation(
+                "host-sync-ast", rel, "missing-module",
+                "hot-path module named in HOST_SYNC_SCOPE does not exist "
+                "— update the scope after the refactor"))
+            continue
+        tree, _lines, ok_lines = parse_module(path)
+        v = _HostSyncVisitor(rel, ok_lines, fns)
+        v.visit(tree)
+        out.extend(Violation(
+            "host-sync-ast", f"{rel}::{scope}", detail,
+            f"{pat} at {rel}:{line} — a blocking device→host sync in the "
+            "hot path; fetch KPIs batched at pass boundaries, or mark a "
+            "host-by-construction site with '# static-ok: <reason>'")
+            for scope, detail, line, pat in v.hits)
+    return out

@@ -1,0 +1,156 @@
+"""The shape oracle: per-config bucket tables, rebuilt host-side.
+
+The census predictor (``predict.py``) must know, *without running
+anything on a device*, every array shape the driver will compile with
+for a bench config. This module re-derives them by running the SAME
+host-side planning code the driver runs:
+
+- the workload comes from ``obs/census.py:_build_workload`` (the exact
+  simulated reads ``make prewarm`` / ``make accuracy-record`` use);
+- the pipeline config comes from ``pipeline/tasks.py:_pipeline_config``
+  over the default ``Config`` — the config the CLI builds for
+  ``-m sr-noccs``;
+- read filtering, bucketing, row rounding and the Lp ladder come from
+  the driver's own helpers (``read_long``, ``_bucket_records``,
+  ``batch_rows``, ``bucket_lp``) — refactored to module level in this
+  PR precisely so the oracle and the driver cannot disagree.
+
+Everything here is numpy/host arithmetic; jax is imported only for
+dataclass types, never initialized against a backend — the oracle is
+safe to run in the prewarm parent (TPU ownership is process-exclusive,
+see ``obs/census.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+MODE = "sr-noccs"          # the census/prewarm CLI mode (census._run_cli)
+SR_PAD_MULTIPLE = 16       # driver._run: device-engine query padding
+SEL_PAD_MULTIPLE = 512     # _SrDevice.take / driver Rsel rounding
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One length bucket as the device engine will pad it."""
+    n_reads: int           # records in the bucket (B0)
+    rows: int              # padded device rows (batch_rows)
+    Lp: int                # padded length (bucket_lp ladder)
+    pad: int               # longest read in the bucket
+
+
+@dataclass
+class ConfigPlan:
+    """Everything shape-determining about one bench config's run."""
+    config: int
+    cap_bases: Optional[int]
+    pc: object                       # PipelineConfig
+    n_short: int
+    m: int                           # padded short-read length
+    coverage: float                  # the driver's SR/LR estimate
+    min_sr_len: int
+    buckets: List[Bucket] = field(default_factory=list)
+
+    @property
+    def S_full(self) -> int:
+        """Query slab rows of a full-set ``_SrDevice.take`` (the +1 is
+        the zero-length pad sentinel row)."""
+        return self.n_short + 1
+
+    def sampled_S(self) -> List[int]:
+        """Every query-slab row count a sampled ``take`` can produce:
+        selections pad to 512-multiples, bounded by the set size."""
+        top = -(-self.n_short // SEL_PAD_MULTIPLE)
+        return [SEL_PAD_MULTIPLE * k for k in range(1, top + 1)]
+
+    def S_variants(self) -> List[int]:
+        """All query slab sizes any pass can see. The sampler only fires
+        when coverage*0.8 >= target (``CoverageSampler.plan``); when it
+        cannot, the full set is the only variant."""
+        out = [self.S_full]
+        targets = (self.pc.sr_coverage, self.pc.finish_coverage)
+        if any(self.coverage * 0.8 >= t for t in targets):
+            out.extend(self.sampled_S())
+        return sorted(set(out))
+
+    def rsel(self) -> int:
+        """The driver's fused-loop Rsel bound (chunk-cap arithmetic):
+        max selection length, floored at 512, rounded to 512."""
+        r = max(self.n_short, SEL_PAD_MULTIPLE)
+        return -(-r // SEL_PAD_MULTIPLE) * SEL_PAD_MULTIPLE
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def build_plan(config: int, cap_bases: Optional[int] = None) -> ConfigPlan:
+    """Rebuild the full shape plan for a bench config (3 or 4; config 3
+    defaults to its pinned prewarm cap, ``census.DEFAULT_CAPS``)."""
+    from proovread_tpu.config import Config
+    from proovread_tpu.obs.census import DEFAULT_CAPS, _build_workload
+    from proovread_tpu.pipeline.driver import (Pipeline, PipelineConfig,
+                                               _bucket_records, batch_rows,
+                                               bucket_lp)
+    from proovread_tpu.pipeline.tasks import _pipeline_config
+
+    if cap_bases is None:
+        cap_bases = DEFAULT_CAPS.get(config)
+    longs, shorts, _truths = _build_workload(config, cap_bases)
+
+    cfg = Config()
+    tasks = cfg.tasks(MODE)
+    pc = _pipeline_config(cfg, MODE, tasks, None, None, True)
+
+    # run_tasks' read-long normalization, then the driver's own filter
+    # (Pipeline._run re-filters with ITS config — same median here)
+    sr_lens = sorted(len(r) for r in shorts)
+    min_sr = sr_lens[len(sr_lens) // 2] if sr_lens else 200
+    kept, _ = Pipeline(PipelineConfig(lr_min_length=None)).read_long(
+        longs, min_sr)
+    kept, _ = Pipeline(pc).read_long(kept, min_sr)
+
+    total_lr = sum(len(r) for r in kept)
+    coverage = (pc.coverage if pc.coverage is not None
+                else sum(len(r) for r in shorts) / max(total_lr, 1))
+
+    m = max(SR_PAD_MULTIPLE,
+            _round_up(max((len(r) for r in shorts), default=0),
+                      SR_PAD_MULTIPLE))
+
+    buckets = []
+    for pad, recs in _bucket_records(kept, pc.batch_reads):
+        buckets.append(Bucket(
+            n_reads=len(recs),
+            rows=batch_rows(len(recs), pc.batch_reads),
+            Lp=bucket_lp(pad, pc.length_slack),
+            pad=pad))
+
+    return ConfigPlan(config=config, cap_bases=cap_bases, pc=pc,
+                      n_short=len(shorts), m=m, coverage=coverage,
+                      min_sr_len=min_sr, buckets=buckets)
+
+
+def chunk_ladder(limit: int) -> List[int]:
+    """Every {2^k, 3*2^(k-1)} ladder value in [1, limit] — the possible
+    static chunk counts (``dcorrect._bucket_chunks`` image)."""
+    from proovread_tpu.pipeline.dcorrect import _bucket_chunks
+    out, v = [], 1
+    while v <= limit:
+        out.append(v)
+        nxt = v + 1
+        while _bucket_chunks(nxt) == v:          # pragma: no cover
+            nxt += 1
+        v = _bucket_chunks(nxt)
+    return out
+
+
+def candidate_chunk_bound(S: int, ap, CH: int) -> int:
+    """Structural upper bound on the per-pass chunk count: the seeder
+    emits at most ``S * 2 * ap.max_candidates`` candidates
+    (``DeviceCandidates`` is [Bq, 2, slots]), so no pass can size its
+    chunk loop past the ladder value covering that."""
+    from proovread_tpu.pipeline.dcorrect import _bucket_chunks
+    n_max = S * 2 * ap.max_candidates
+    return _bucket_chunks(max(1, -(-n_max // CH)))

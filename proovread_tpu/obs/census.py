@@ -320,6 +320,54 @@ def compile_check(entries: List[Dict[str, Any]],
             "pools": pool_names, "checks": checks}
 
 
+def _crosslink_predicted_census() -> None:
+    """Stale-budget detection (docs/STATIC_ANALYSIS.md): cross-link the
+    static-analysis census predictor against the newest recorded
+    compile-ledger artifact. A predicted-but-never-observed program
+    class means the committed budget carries slack for programs no real
+    run compiles — worth ratcheting down; a predicted MISS means the
+    shape oracle lost a call site (``make static-check`` fails on it;
+    here it is a warning so compile-check stays a pure cold-start gate).
+    Non-fatal by design: an environment without the analysis package's
+    inputs still gets the plain gate."""
+    try:
+        import re as _re
+
+        from proovread_tpu.analysis import predict as _predict
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        ledgers = sorted(_glob.glob(os.path.join(root, "LEDGER_*.jsonl")))
+        if not ledgers:
+            return
+        # the artifact names its config (LEDGER_r12_config4.jsonl) —
+        # reconciling config-4 predictions against a config-3 recording
+        # would print nothing but spurious mismatches
+        m = _re.search(r"config(\d+)", os.path.basename(ledgers[-1]))
+        if not m:
+            print(f"compile-check: ledger {ledgers[-1]} does not name "
+                  "its config — predicted-census cross-link skipped",
+                  file=sys.stderr)
+            return
+        pred = _predict.predict_config(
+            int(m.group(1)),
+            interpret=_predict.interpret_for_backend(
+                _predict.ledger_backend(ledgers[-1])))
+        rec = _predict.reconcile(
+            pred, _predict.load_ledger_programs(ledgers[-1]))
+        for entry, n in sorted(rec["unobserved"].items()):
+            print(f"compile-check: stale-budget: {entry}: {n} predicted "
+                  f"program class(es) never observed in {ledgers[-1]} — "
+                  "unreachable classes should ratchet "
+                  "analysis/budget.json down", file=sys.stderr)
+        for m in rec["missing"]:
+            print("compile-check: WARNING predicted census missed an "
+                  f"observed program: {json.dumps(m)} — run "
+                  "`make static-check`", file=sys.stderr)
+    except Exception as e:                              # noqa: BLE001
+        print(f"compile-check: predicted-census cross-link unavailable: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+
+
 # -- CLI -------------------------------------------------------------------
 
 def _resolve_paths(args_paths: List[str]) -> List[str]:
@@ -444,6 +492,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("compile-check: no COMPILE history files found",
               file=sys.stderr)
         return 0
+    _crosslink_predicted_census()
     verdict = compile_check(load_rows(paths),
                             warm_threshold=args.warm_threshold,
                             warm_min_abs_s=args.warm_min_abs_s,

@@ -77,7 +77,8 @@ def make_dp_mesh(n_devices: Optional[int] = None,
 
 def compile_step_with_plan(body, mesh: Optional[Mesh] = None,
                            in_specs=None, out_specs=None,
-                           check_vma: bool = False):
+                           check_vma: bool = False,
+                           donate_argnums: tuple = ()):
     """Central compile chokepoint for iteration steps (SNIPPETS.md's
     Titanax ``compile_step_with_plan``): no mesh -> plain ``jit`` of the
     body; any mesh -> ``shard_map`` (via the version shim) under ``jit``.
@@ -91,16 +92,26 @@ def compile_step_with_plan(body, mesh: Optional[Mesh] = None,
     align/consensus params and the mesh are closure statics of the body,
     invisible to the call-args signature, and without the salt a
     recompiled variant at the same array shapes would be misread as a
-    tracing-cache hit."""
+    tracing-cache hit.
+
+    ``donate_argnums`` donates the named positional args of the COMPILED
+    step (plain jit and shard_map-under-jit alike): the sharded read
+    state is rebound from each step's outputs by the driver's mesh loop,
+    so donating it lets XLA alias the input and output slabs across the
+    whole iteration schedule (ROADMAP item 1's ``donation_vector``
+    lever, SNIPPETS.md [1]; enforced by the static-check donation rule).
+    """
     from proovread_tpu.obs.profile import attributed
 
     step_name = f"dmesh:{getattr(body, '__name__', 'step')}"
     salt = f"v{next(_step_seq)}"
     if mesh is None:
-        return attributed(step_name, sig_salt=salt)(jax.jit(body))
+        return attributed(step_name, sig_salt=salt)(
+            jax.jit(body, donate_argnums=donate_argnums))
     mapped = compat.shard_map(body, mesh, in_specs=in_specs,
                               out_specs=out_specs, check_vma=check_vma)
-    return attributed(step_name, sig_salt=salt)(jax.jit(mapped))
+    return attributed(step_name, sig_salt=salt)(
+        jax.jit(mapped, donate_argnums=donate_argnums))
 
 
 # compiled steps keyed by (device ids, params, statics) — a shrunken mesh
@@ -158,6 +169,8 @@ def build_sharded_step(
     truncated — and therefore mesh-shape-DEpendent — output.
     """
     itp = bsw.default_interpret() if interpret is None else interpret
+    # static-ok: host-sync — device *ids* are host attributes of the
+    # placement, read once per step build, never a device fetch
     key = (tuple(int(d.id) for d in mesh.devices.flat), ap, cns,
            chunks_per_shard, chunk, seed_stride, seed_min_votes, itp,
            collect_qc)
@@ -217,7 +230,11 @@ def build_sharded_step(
         local_step, mesh,
         in_specs=(shard,) * 5 + (repl,) * 5,
         out_specs=out_specs,
-        check_vma=False)
+        check_vma=False,
+        # the evolving read state (codes/qual/lengths/mask_cols) is
+        # rebound from the outputs every pass; row_valid and the query
+        # slabs are reused across passes and stay un-donated
+        donate_argnums=(0, 1, 2, 3))
     _STEP_CACHE[key] = step
     return step
 

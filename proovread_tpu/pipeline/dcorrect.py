@@ -1042,6 +1042,13 @@ _fused_pass = obs.profile.attributed("fused_pass")(functools.partial(
                      "n_rest", "Lp", "seed_stride", "seed_min_votes",
                      "shortcut_frac", "min_gain", "full_set",
                      "collect_qc"),
+    # the evolving read state is dead after the call — every caller
+    # rebinds codes/qual/lengths/mask_cols from the outputs, so the
+    # input slabs (2 x [B, Lp] bytes + the bool mask) alias the output
+    # buffers instead of doubling residency for the whole multi-pass
+    # program (ROADMAP item 1's donation lever; enforced by the
+    # static-check donation rule against analysis/entrypoints.py)
+    donate_argnums=(0, 1, 2, 3),
 )
 def fused_iterations(codes, qual, lengths, mask_cols, frac_prev,
                      sr_codes, sr_rc, sr_qual, sr_lengths,
@@ -1283,6 +1290,8 @@ class DeviceCorrector:
             return call, stats
 
         # one host fetch of the per-candidate scalars for the chimera scan
+        # static-ok: host-sync — ONE batched end-of-pass fetch (the
+        # collect_aln contract), not a mid-pass stall
         h = jax.device_get(scalars)
         (h_lread, h_pos0, h_span, h_adm, h_qs, h_qe, h_ws, h_rs, h_re,
          h_sread, h_strand, h_score) = h

@@ -280,6 +280,56 @@ def _declare_metrics(reg) -> None:
               "(classified sample)")
 
 
+def batch_rows(n: int, batch_reads: int) -> int:
+    """Device batch row count for ``n`` reads: rounded up to a multiple
+    of 32 (bounds jit variants while not padding tiny buckets to the
+    full batch). Shared with the static-analysis shape oracle
+    (``analysis/shapes.py``) — the program-zoo predictor must derive row
+    counts from the SAME arithmetic the driver pads with."""
+    return min(batch_reads, max(32, -(-n // 32) * 32))
+
+
+def bucket_lp(pad: int, length_slack: float) -> int:
+    """Padded bucket length Lp for a bucket whose longest read is
+    ``pad``: slack for consensus growth, then the {2^k, 3*2^(k-1)}
+    ladder x 512 — every distinct Lp is a fresh compile of the whole
+    per-bucket program stack, and real length spreads otherwise produce
+    many shapes within ~10% of each other (config 3: 5 shapes in
+    17.9k-20k). Shared with ``analysis/shapes.py`` (see
+    :func:`batch_rows`)."""
+    want = int(pad * (1 + length_slack)) + 128
+    return 512 * _bucket_chunks(max(1, -(-want // 512)))
+
+
+def iteration_consensus_params(cfg: "PipelineConfig",
+                               coverage: float) -> ConsensusParams:
+    """Consensus params of the iteration passes (1..n). Module-level so
+    the static-analysis census predictor builds the exact statics the
+    driver compiles with — these dataclasses are part of every fused
+    program's compile key."""
+    max_cov = max(int(min(coverage, cfg.sr_coverage)
+                      * cfg.coverage_scale + 0.5), 1)
+    return ConsensusParams(
+        qual_weighted=False, use_ref_qual=True,
+        indel_taboo_length=cfg.indel_taboo_length,
+        max_coverage=max_cov, trim=cfg.sr_trim,
+    )
+
+
+def finish_consensus_params(cfg: "PipelineConfig",
+                            coverage: float) -> ConsensusParams:
+    """Finish-pass consensus params: strict, no ref-qual recycling
+    (bin/proovread:1573-1579). Shared with the census predictor like
+    :func:`iteration_consensus_params`."""
+    return ConsensusParams(
+        qual_weighted=False, use_ref_qual=False,
+        indel_taboo_length=cfg.indel_taboo_length,
+        max_coverage=max(int(min(coverage, cfg.finish_coverage)
+                             * cfg.coverage_scale + 0.5), 1),
+        trim=cfg.sr_trim,
+    )
+
+
 def _align_params(mode: str, iteration: Optional[int]) -> AlignParams:
     """Built-in task schedule (cfg task-counter suffix semantics,
     bin/proovread:1989-2024): iteration None = finish."""
@@ -603,12 +653,7 @@ class Pipeline:
                     if not batch_recs:
                         continue
                     pad = max(len(r) for r in batch_recs)
-                want = int(pad * (1 + cfg.length_slack)) + 128
-                # Lp on a {2^k, 3*2^(k-1)} ladder: every distinct Lp is a
-                # fresh compile of the whole per-bucket program stack, and
-                # real length spreads otherwise produce many shapes within
-                # ~10% of each other (config 3: 5 shapes in 17.9k-20k)
-                Lp = 512 * _bucket_chunks(max(1, -(-want // 512)))
+                Lp = bucket_lp(pad, cfg.length_slack)
                 key = bucket_key(batch_recs)
                 tb0 = time.monotonic()
                 # bases in the span args: per-bucket cost attribution
@@ -720,9 +765,8 @@ class Pipeline:
         return PipelineResult(untrimmed, trimmed, ignored, all_chim, reports)
 
     def _batch_rows(self, n: int) -> int:
-        """Round the batch row count up to a multiple of 32 (bounds jit
-        variants while not padding tiny buckets to the full batch)."""
-        return min(self.config.batch_reads, max(32, -(-n // 32) * 32))
+        """See module-level :func:`batch_rows`."""
+        return batch_rows(n, self.config.batch_reads)
 
     def _get_dc(self, chunk: int):
         """DeviceCorrector per chunk size (the ladder's chunk-halved rung
@@ -977,8 +1021,6 @@ class Pipeline:
         lengths = jnp.asarray(lr.lengths)
         mask_cols = None
         masked_frac = -cfg.mask_min_gain_frac
-        max_cov = max(int(min(coverage, cfg.sr_coverage)
-                          * cfg.coverage_scale + 0.5), 1)
 
         # correction QC (obs/qc.py): none of the feeding per-row device
         # reductions run while no recorder is installed (tier-1 guard:
@@ -994,11 +1036,7 @@ class Pipeline:
         from proovread_tpu.align import bsw as _bsw
 
         def _iter_cns():
-            return ConsensusParams(
-                qual_weighted=False, use_ref_qual=True,
-                indel_taboo_length=cfg.indel_taboo_length,
-                max_coverage=max_cov, trim=cfg.sr_trim,
-            )
+            return iteration_consensus_params(cfg, coverage)
 
         def _mask_p(it):
             return (cfg.hcr_mask if it < 4
@@ -1423,13 +1461,7 @@ class Pipeline:
                       bucket=gi):
             _inj(cfg.n_iterations + 1)
             ap = _align_params_cfg(cfg, None)
-            cns = ConsensusParams(
-                qual_weighted=False, use_ref_qual=False,
-                indel_taboo_length=cfg.indel_taboo_length,
-                max_coverage=max(int(min(coverage, cfg.finish_coverage)
-                                     * cfg.coverage_scale + 0.5), 1),
-                trim=cfg.sr_trim,
-            )
+            cns = finish_consensus_params(cfg, coverage)
             sel = sampler.select(n_short, coverage, cfg.finish_coverage) \
                 if cfg.sampling else np.arange(n_short)
             qc, rcq, qq, qlen = sr_dev.take(sel)
@@ -1550,8 +1582,6 @@ class Pipeline:
         # (reference: $masked_prev = -$masked_gain, bin/proovread:2026-2047)
         masked_frac = -cfg.mask_min_gain_frac
 
-        max_cov = max(int(min(coverage, cfg.sr_coverage) * cfg.coverage_scale + 0.5), 1)
-
         it = 1
         while it <= cfg.n_iterations:
             task = f"bwa-{cfg.mode[:2]}-{it}"
@@ -1560,11 +1590,7 @@ class Pipeline:
                 # qual-weighted voting is a utg-task knob only; sr/mr
                 # iterations vote uniformly but recycle ref quals
                 # (bin/proovread:1573-1589)
-                cns = ConsensusParams(
-                    qual_weighted=False, use_ref_qual=True,
-                    indel_taboo_length=cfg.indel_taboo_length,
-                    max_coverage=max_cov, trim=cfg.sr_trim,
-                )
+                cns = iteration_consensus_params(cfg, coverage)
                 fc = FastCorrector(align_params=ap, cns_params=cns,
                                    chunk_rows=cfg.host_chunk_rows)
 
@@ -1623,13 +1649,7 @@ class Pipeline:
         with obs.span(f"bwa-{cfg.mode[:2]}-finish", cat="pass",
                       engine="scan"):
             ap = _align_params_cfg(cfg, None)
-            cns = ConsensusParams(
-                qual_weighted=False, use_ref_qual=False,
-                indel_taboo_length=cfg.indel_taboo_length,
-                max_coverage=max(int(min(coverage, cfg.finish_coverage)
-                                     * cfg.coverage_scale + 0.5), 1),
-                trim=cfg.sr_trim,
-            )
+            cns = finish_consensus_params(cfg, coverage)
             fc = FastCorrector(align_params=ap, cns_params=cns,
                                chunk_rows=cfg.host_chunk_rows)
             sel = sampler.select(len(short_records), coverage,

@@ -4,89 +4,36 @@ PERF.md's round-4 profile showed ~80% of device time in XLA gathers /
 scatters / relayouts executing on the TPU scalar core at ~10 ns/element,
 against ~10% in the bsw alignment kernel itself. bsw v2 (in-kernel DMA of
 query rows + map windows, packed inserted-base emission) removed every
-XLA gather from the per-chunk fused path; this lint pins that property so
-it cannot silently regress.
+XLA gather from the per-chunk fused path.
 
-Rule: in the jaxpr of the fused pass (and of the fused iteration
-program), every ``scan`` whose body contains a ``pallas_call`` is a chunk
-loop — its body must contain ZERO ``gather`` equations (recursively,
-through cond branches and nested jits, but NOT inside pallas kernels,
-which are Mosaic-compiled and never lower to XLA scalar-core gathers).
-Scans without kernels (the seeder's probe-slab scan, searchsorted's
-binary-search scan inside the per-pass admission) legitimately gather and
-are out of scope: they run once per pass, not once per chunk.
+Since PR 12 the jaxpr traversal and the rule itself live in the
+static-analysis engine (``proovread_tpu/analysis``) — this module pins
+(1) that the production fused programs pass the ENGINE's ``no-gather``
+rule at the miniature trace shapes, and (2) that the engine is
+falsifiable: a planted ``take_along_axis`` in a kernel-bearing scan must
+be flagged, and a fused path that silently loses its chunk scan must
+fail loudly rather than vacuously pass. The whole-repo sweep (every
+registry entry at once) runs in ``make static-check``, not tier-1.
 """
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
-
-from jax.extend import core as jax_core
 
 from proovread_tpu.align import bsw
 from proovread_tpu.align.params import AlignParams
+from proovread_tpu.analysis import engine
+from proovread_tpu.analysis.entrypoints import EntrySpec
+from proovread_tpu.analysis.rules import rule_no_gather
 from proovread_tpu.consensus.params import ConsensusParams
 
 
-def _sub_jaxprs(eqn):
-    """Immediate child jaxprs of one equation (scan/cond/while/pjit/...)."""
-    for v in eqn.params.values():
-        if isinstance(v, jax_core.ClosedJaxpr):
-            yield v.jaxpr
-        elif isinstance(v, jax_core.Jaxpr):
-            yield v
-        elif isinstance(v, (tuple, list)):
-            for x in v:
-                if isinstance(x, jax_core.ClosedJaxpr):
-                    yield x.jaxpr
-                elif isinstance(x, jax_core.Jaxpr):
-                    yield x
-
-
-def _walk(jaxpr, *, into_pallas=False):
-    """All equations under ``jaxpr``, depth-first."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        if eqn.primitive.name == "pallas_call" and not into_pallas:
-            continue
-        for sub in _sub_jaxprs(eqn):
-            yield from _walk(sub, into_pallas=into_pallas)
-
-
-def _contains_pallas(jaxpr) -> bool:
-    return any(e.primitive.name == "pallas_call" for e in _walk(jaxpr))
-
-
-def _chunk_scan_bodies(closed):
-    """Bodies of every scan that contains a pallas_call (= a chunk loop)."""
-    out = []
-
-    def visit(jaxpr):
-        for eqn in jaxpr.eqns:
-            subs = list(_sub_jaxprs(eqn))
-            if eqn.primitive.name == "scan":
-                out.extend(s for s in subs if _contains_pallas(s))
-            if eqn.primitive.name != "pallas_call":
-                for s in subs:
-                    visit(s)
-
-    visit(closed.jaxpr)
-    return out
-
-
-def _assert_gather_free(bodies, what):
-    assert bodies, f"{what}: no kernel-bearing chunk scans found — the " \
-        "fused path changed shape; update this lint, don't delete it"
-    for body in bodies:
-        gathers = [e for e in _walk(body)
-                   if e.primitive.name == "gather"]
-        assert not gathers, (
-            f"{what}: {len(gathers)} XLA gather op(s) reappeared inside a "
-            f"chunk scan (first: {gathers[0]}). Every per-chunk gather "
-            "runs at ~10 ns/element on the TPU scalar core — route the "
-            "access through the bsw v2 kernel's DMA path instead "
-            "(PERF.md attack plan #2).")
+def _run_rule(closed, what, chunk_scan=True):
+    """Apply the engine's no-gather rule to an already-traced jaxpr."""
+    spec = EntrySpec(what, lambda: None, lambda: ((), {}),
+                     chunk_scan=chunk_scan)
+    traced = engine.TracedEntry(spec=spec, closed=closed)
+    return rule_no_gather(spec, traced)
 
 
 def _small_args(B=2, Lp=256, S=8, m=128, CH=128, n_chunks=2):
@@ -127,7 +74,9 @@ def test_fused_pass_chunk_loop_gather_free():
     closed = jax.make_jaxpr(f)(
         map2, ign2, codes, qual, lengths, qf, qlen,
         sread, strand, lread, diag, jnp.int32(CH))
-    _assert_gather_free(_chunk_scan_bodies(closed), "fused_pass")
+    assert engine.kernel_scan_bodies(closed), \
+        "fused_pass lost its kernel-bearing chunk scan"
+    assert _run_rule(closed, "fused_pass") == []
 
 
 def test_fused_iterations_chunk_loop_gather_free():
@@ -154,12 +103,14 @@ def test_fused_iterations_chunk_loop_gather_free():
     closed = jax.make_jaxpr(f)(
         codes, qual, lengths, ign2, qf, qual[:, :m].astype(jnp.uint8),
         qlen, sels, pvs)
-    _assert_gather_free(_chunk_scan_bodies(closed), "fused_iterations")
+    assert engine.kernel_scan_bodies(closed), \
+        "fused_iterations lost its kernel-bearing chunk scan"
+    assert _run_rule(closed, "fused_iterations") == []
 
 
-def test_lint_catches_a_planted_gather():
-    """The guard itself must be falsifiable: a scan body that runs a
-    pallas kernel AND a take_along_axis gather must trip the assertion."""
+def _planted_jaxpr(with_gather: bool):
+    """A scan whose body runs a Pallas kernel, optionally followed by a
+    take_along_axis gather — the rule's falsifiability plant."""
     from jax.experimental import pallas as pl
 
     def noop_kernel(x_ref, o_ref):
@@ -171,15 +122,33 @@ def test_lint_catches_a_planted_gather():
             noop_kernel,
             out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
             interpret=True)(x)
-        g = jnp.take_along_axis(y, idx, axis=1)      # the planted gather
-        return carry + g.sum(), None
+        if with_gather:
+            y = jnp.take_along_axis(y, idx, axis=1)
+        return carry + y.sum(), None
 
     def f(idxs):
         out, _ = jax.lax.scan(body, jnp.float32(0), idxs)
         return out
 
-    closed = jax.make_jaxpr(f)(jnp.zeros((3, 8, 1), jnp.int32))
-    bodies = _chunk_scan_bodies(closed)
-    assert bodies
-    with pytest.raises(AssertionError, match="gather"):
-        _assert_gather_free(bodies, "planted")
+    return jax.make_jaxpr(f)(jnp.zeros((3, 8, 1), jnp.int32))
+
+
+def test_engine_flags_a_planted_gather():
+    """Falsifiability, side 1: the engine rule must flag the plant."""
+    closed = _planted_jaxpr(with_gather=True)
+    assert engine.kernel_scan_bodies(closed)
+    violations = _run_rule(closed, "planted")
+    assert len(violations) == 1
+    assert violations[0].rule == "no-gather"
+    assert "gather" in violations[0].message
+    # ...and the clean twin passes (side 2)
+    assert _run_rule(_planted_jaxpr(with_gather=False), "clean") == []
+
+
+def test_engine_flags_a_lost_chunk_scan():
+    """A 'gather-free' verdict must never come from the chunk scan
+    silently disappearing: chunk_scan=True entries with no kernel scan
+    are a violation, not a vacuous pass."""
+    closed = jax.make_jaxpr(lambda x: x + 1)(jnp.zeros((4,), jnp.float32))
+    violations = _run_rule(closed, "shapeless", chunk_scan=True)
+    assert [v.detail for v in violations] == ["no-chunk-scan"]

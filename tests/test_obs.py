@@ -5,7 +5,6 @@ end-to-end (docs/OBSERVABILITY.md)."""
 
 import json
 import os
-import re
 import time
 
 import numpy as np
@@ -231,25 +230,37 @@ def test_no_naked_timers():
     """Every duration in the pipeline must come from the tracer's
     monotonic clock: a bare ``time.time()`` timing site in
     ``proovread_tpu/pipeline`` (or the CLI / obs layer itself) breaks the
-    one-clock-one-schema invariant this subsystem exists for."""
+    one-clock-one-schema invariant this subsystem exists for. Since PR 12
+    the scan is the static-analysis engine's ``naked-timer`` AST rule
+    (``proovread_tpu/analysis/rules.py``) — this test runs it against the
+    real tree and proves it falsifiable against a planted offender."""
+    from proovread_tpu.analysis.rules import rule_naked_timer
+
     pkg = os.path.join(os.path.dirname(__file__), "..", "proovread_tpu")
-    pat = re.compile(r"\btime\.time\(\)")
-    offenders = []
-    scan = [os.path.join(pkg, "pipeline"), os.path.join(pkg, "obs"),
-            os.path.join(pkg, "cli.py")]
-    for target in scan:
-        files = ([target] if target.endswith(".py") else
-                 [os.path.join(target, f) for f in os.listdir(target)
-                  if f.endswith(".py")])
-        for f in files:
-            with open(f) as fh:
-                for ln_no, line in enumerate(fh, 1):
-                    if pat.search(line):
-                        offenders.append(
-                            f"{os.path.relpath(f, pkg)}:{ln_no}")
+    offenders = rule_naked_timer(pkg)
     assert not offenders, (
         "bare time.time() timing sites (use obs.span / time.monotonic): "
-        f"{offenders}")
+        f"{[v.key for v in offenders]}")
+
+
+def test_naked_timer_rule_is_falsifiable(tmp_path):
+    """The engine rule must flag a planted time.time() — and honor an
+    inline static-ok waiver — in a synthetic package tree."""
+    from proovread_tpu.analysis.rules import rule_naked_timer
+
+    (tmp_path / "pipeline").mkdir()
+    (tmp_path / "obs").mkdir()
+    (tmp_path / "cli.py").write_text("import time\n")
+    (tmp_path / "obs" / "__init__.py").write_text("")
+    (tmp_path / "pipeline" / "bad.py").write_text(
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.time()\n"
+        "    ok = time.time()  # static-ok: naked-timer test plant\n"
+        "    return t0, ok\n")
+    v = rule_naked_timer(str(tmp_path))
+    assert [x.detail for x in v] == ["time.time()#0"]
+    assert v[0].where.endswith("bad.py::f")
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,524 @@
+"""Static-analysis engine tests (proovread_tpu/analysis).
+
+Per-rule TWO-SIDED falsifiability (a planted violation is flagged, its
+clean twin passes), engine traversal units (cond/pjit recursion, pallas
+exclusion), the baseline ratchet, the donation contract on the REAL
+production entry points (the PR 12 donation bank), the shape oracle, and
+the predictor-vs-ledger reconciliation — including the acceptance pin:
+predicted ⊇ observed against the committed LEDGER_r12_config4.jsonl.
+
+The whole-registry sweep stays in ``make static-check`` (tier-1 keeps
+only the miniature traces; suite budget discipline per ROADMAP).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from proovread_tpu.analysis import engine
+from proovread_tpu.analysis import predict
+from proovread_tpu.analysis import rules
+from proovread_tpu.analysis import shapes
+from proovread_tpu.analysis.entrypoints import EntrySpec, registry, sds
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+LEDGER = os.path.join(REPO, "LEDGER_r12_config4.jsonl")
+
+
+def _spec(name="t", chunk_scan=False, dead_args=()):
+    return EntrySpec(name, lambda: None, lambda: ((), {}),
+                     chunk_scan=chunk_scan, dead_args=dead_args)
+
+
+def _traced(closed, spec=None):
+    return engine.TracedEntry(spec=spec or _spec(), closed=closed)
+
+
+# --------------------------------------------------------------------------
+# traversal
+# --------------------------------------------------------------------------
+
+class TestTraversal:
+    def test_walk_recurses_cond_and_pjit(self):
+        inner = jax.jit(lambda x: jnp.sin(x))
+
+        def f(x):
+            return jax.lax.cond(x.sum() > 0, lambda v: inner(v) * 2,
+                                lambda v: v, x)
+
+        closed = jax.make_jaxpr(f)(jnp.ones(4))
+        prims = {e.primitive.name for e in engine.walk(closed.jaxpr)}
+        assert "cond" in prims
+        assert "sin" in prims, "walk must recurse cond branches AND pjit"
+
+    def test_walk_excludes_pallas_bodies_by_default(self):
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = jnp.exp(x_ref[...])
+
+        def f(x):
+            return pl.pallas_call(
+                kernel, out_shape=jax.ShapeDtypeStruct((8, 128),
+                                                       jnp.float32),
+                interpret=True)(x)
+
+        closed = jax.make_jaxpr(f)(jnp.ones((8, 128)))
+        outside = {e.primitive.name for e in engine.walk(closed.jaxpr)}
+        inside = {e.primitive.name
+                  for e in engine.walk(closed.jaxpr, into_pallas=True)}
+        assert "exp" not in outside, \
+            "pallas kernels are Mosaic-compiled — XLA rules must not " \
+            "see their bodies"
+        assert "exp" in inside
+
+    def test_kernel_scan_bodies_ignores_plain_scans(self):
+        def f(xs):
+            out, _ = jax.lax.scan(lambda c, x: (c + x.sum(), None),
+                                  jnp.float32(0), xs)
+            return out
+
+        closed = jax.make_jaxpr(f)(jnp.ones((3, 4)))
+        assert engine.kernel_scan_bodies(closed) == []
+
+
+# --------------------------------------------------------------------------
+# ratchet + static-ok
+# --------------------------------------------------------------------------
+
+class TestRatchet:
+    def test_new_known_resolved_split(self):
+        a = engine.Violation("r", "w", "a")
+        b = engine.Violation("r", "w", "b")
+        baseline = {"schema": 1,
+                    "violations": {b.key: "accepted", "r::gone::x": ""}}
+        r = engine.ratchet([a, b], baseline)
+        assert [v.key for v in r["new"]] == [a.key]
+        assert [v.key for v in r["known"]] == [b.key]
+        assert r["resolved"] == ["r::gone::x"]
+
+    def test_keys_have_no_line_numbers(self):
+        v = engine.Violation("host-sync-ast", "m.py::f", ".item()#0",
+                             "at m.py:123")
+        assert "123" not in v.key
+
+    def test_save_and_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / "baseline.json")
+        v = engine.Violation("r", "w", "d", "msg")
+        engine.save_baseline([v], p)
+        loaded = engine.load_baseline(p)
+        assert list(loaded["violations"]) == [v.key]
+
+    def test_static_ok_marker_covers_block_below(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("x = 1  # static-ok: inline\n"
+                     "# static-ok: block reason\n"
+                     "# continuation comment\n"
+                     "y = 2\n"
+                     "z = 3\n")
+        _tree, _lines, ok = engine.parse_module(str(p))
+        assert ok == {1, 2, 4}, "marker covers its line and the first " \
+                                "code line after its comment block"
+
+    def test_trailing_marker_does_not_waive_the_next_line(self, tmp_path):
+        """A trailing '# static-ok' on a code line waives THAT line
+        only — the statement below must stay flagged (code-review
+        finding: the block extension must not apply to code lines)."""
+        p = tmp_path / "m.py"
+        p.write_text("a = 1  # static-ok: just this one\n"
+                     "b = 2\n")
+        _tree, _lines, ok = engine.parse_module(str(p))
+        assert ok == {1}
+
+
+# --------------------------------------------------------------------------
+# jaxpr rules — two-sided falsifiability
+# --------------------------------------------------------------------------
+
+def _kernel_scan_jaxpr(extra=None):
+    """A kernel-bearing scan, optionally with a planted body op."""
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def body(carry, x):
+        y = pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct((64, 128), jnp.int8),
+            interpret=True)(x)
+        if extra is not None:
+            carry = carry + extra(y)
+        return carry + y.astype(jnp.float32).sum() * 0, None
+
+    def f(xs):
+        out, _ = jax.lax.scan(body, jnp.float32(0), xs)
+        return out
+
+    return jax.make_jaxpr(f)(jnp.zeros((2, 64, 128), jnp.int8))
+
+
+class TestDtypeRules:
+    def test_wide_dtype_flags_an_x64_leak(self):
+        from jax.experimental import enable_x64
+        with enable_x64():
+            closed = jax.make_jaxpr(
+                lambda x: x.astype(jnp.int64) + 1)(jnp.zeros(4, jnp.int32))
+        v = rules.rule_wide_dtype(_spec("leak"), _traced(closed))
+        assert v and all(x.rule == "wide-dtype" for x in v)
+        assert any("int64" in x.detail for x in v)
+
+    def test_wide_dtype_clean_tree_passes(self):
+        closed = jax.make_jaxpr(lambda x: x + 1)(jnp.zeros(4, jnp.int32))
+        assert rules.rule_wide_dtype(_spec(), _traced(closed)) == []
+
+    def test_packed_upcast_flags_a_planted_widening(self):
+        closed = _kernel_scan_jaxpr(
+            extra=lambda y: y.astype(jnp.float32).sum())
+        v = rules.rule_packed_upcast(_spec("w"), _traced(closed))
+        assert len(v) == 1 and v[0].rule == "packed-upcast"
+
+    def test_packed_upcast_clean_scan_passes(self):
+        closed = _kernel_scan_jaxpr()
+        # the 0-multiplied f32 sum above threshold is itself a convert —
+        # build a truly clean body instead
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def f(xs):
+            def body(c, x):
+                y = pl.pallas_call(
+                    kernel,
+                    out_shape=jax.ShapeDtypeStruct((64, 128), jnp.int8),
+                    interpret=True)(x)
+                return c + y.astype(jnp.int32).sum(), None
+            out, _ = jax.lax.scan(body, jnp.int32(0), xs)
+            return out
+
+        clean = jax.make_jaxpr(f)(jnp.zeros((2, 64, 128), jnp.int8))
+        assert rules.rule_packed_upcast(_spec(), _traced(clean)) == []
+        del closed
+
+
+class TestHostSyncJaxprRule:
+    def test_flags_a_pure_callback(self):
+        def f(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v),
+                jax.ShapeDtypeStruct((4,), np.float32), x)
+
+        closed = jax.make_jaxpr(f)(jnp.zeros(4, jnp.float32))
+        v = rules.rule_host_sync_jaxpr(_spec("cb"), _traced(closed))
+        assert v and v[0].detail.startswith("callback:")
+
+    def test_clean_program_passes(self):
+        closed = jax.make_jaxpr(lambda x: x * 2)(jnp.zeros(4))
+        assert rules.rule_host_sync_jaxpr(_spec(), _traced(closed)) == []
+
+
+class TestDonationRule:
+    def _traced_lowerable(self, fn, spec, *args):
+        t = fn.trace(*args)
+        return engine.TracedEntry(
+            spec=spec, closed=t.jaxpr, args=args, kwargs={})
+
+    def test_undonated_dead_slab_is_flagged(self):
+        f = jax.jit(lambda a, b: (a + 1, b))
+        spec = _spec("undonated", dead_args=(0,))
+        spec.fn = lambda: f
+        tr = self._traced_lowerable(f, spec, sds((8, 8), np.float32),
+                                    sds((8,), np.float32))
+        v = rules.rule_donation(spec, tr)
+        assert [x.detail for x in v] == ["arg0-undonated"]
+
+    def test_donated_and_declared_passes(self):
+        f = jax.jit(lambda a, b: (a + 1, b), donate_argnums=(0,))
+        spec = _spec("ok", dead_args=(0,))
+        spec.fn = lambda: f
+        tr = self._traced_lowerable(f, spec, sds((8, 8), np.float32),
+                                    sds((8,), np.float32))
+        assert rules.rule_donation(spec, tr) == []
+
+    def test_donated_but_undeclared_is_flagged(self):
+        f = jax.jit(lambda a, b: (a + 1, b), donate_argnums=(0,))
+        spec = _spec("undeclared", dead_args=())
+        spec.fn = lambda: f
+        tr = self._traced_lowerable(f, spec, sds((8, 8), np.float32),
+                                    sds((8,), np.float32))
+        v = rules.rule_donation(spec, tr)
+        assert [x.detail for x in v] == ["arg0-undeclared"]
+
+
+@pytest.mark.heavy
+def test_production_slab_entry_points_donate():
+    """The PR 12 donation bank, pinned: fused_iterations and the dmesh
+    compile chokepoint donate their dead read-state slabs (args 0-3) —
+    the donation rule over the REAL registry specs finds nothing."""
+    specs = [s for s in registry()
+             if s.name in ("fused_iterations", "dmesh:step")]
+    assert len(specs) == 2
+    violations, errors = engine.run_jaxpr_rules(specs, rules=["donation"])
+    assert errors == []
+    assert violations == []
+
+
+# --------------------------------------------------------------------------
+# host-sync AST rule
+# --------------------------------------------------------------------------
+
+class TestHostSyncAstRule:
+    def _tree(self, tmp_path, body):
+        (tmp_path / "pipeline").mkdir()
+        (tmp_path / "pipeline" / "dcorrect.py").write_text(body)
+        return str(tmp_path)
+
+    def test_flags_syncs_in_scoped_functions_only(self, tmp_path):
+        root = self._tree(tmp_path, (
+            "import numpy as np\n"
+            "class DeviceCorrector:\n"
+            "    def correct_pass(self, n_valid, xs):\n"
+            "        a = int(n_valid)\n"
+            "        b = xs.item()\n"
+            "        c = np.asarray(xs)\n"
+            "        d = int(n_valid)  # static-ok: test waiver\n"
+            "        return a, b, c, d\n"
+            "def host_plumbing(x):\n"
+            "    return int(x), np.asarray(x), x.item()\n"))
+        v = [x for x in rules.rule_host_sync_ast(root)
+             if "dcorrect" in x.where]
+        details = sorted(x.detail for x in v)
+        assert details == [".item()#0", "int()#0", "np.asarray()#0"]
+        assert all("correct_pass" in x.where for x in v), \
+            "host_plumbing is outside the declared hot scope"
+
+    def test_clean_scoped_function_passes(self, tmp_path):
+        root = self._tree(tmp_path, (
+            "class DeviceCorrector:\n"
+            "    def correct_pass(self):\n"
+            "        return len([1])\n"))
+        v = [x for x in rules.rule_host_sync_ast(root)
+             if "dcorrect" in x.where and x.detail != "missing-module"]
+        assert v == []
+
+    def test_missing_scoped_module_is_loud(self, tmp_path):
+        v = rules.rule_host_sync_ast(str(tmp_path))
+        assert v and all(x.detail == "missing-module" for x in v), \
+            "a renamed hot-path module must fail the scope, not skip it"
+
+
+# --------------------------------------------------------------------------
+# shape oracle + predictor
+# --------------------------------------------------------------------------
+
+class TestShapeOracle:
+    def test_config4_plan_geometry(self):
+        plan = shapes.build_plan(4)
+        assert plan.n_short > 0 and plan.m % 16 == 0
+        assert plan.buckets, "config 4 must bucket at least once"
+        from proovread_tpu.pipeline.dcorrect import _bucket_chunks
+        for b in plan.buckets:
+            assert b.rows % 32 == 0 or b.rows == plan.pc.batch_reads
+            assert b.Lp % 512 == 0
+            assert _bucket_chunks(b.Lp // 512) == b.Lp // 512, \
+                "Lp must sit on the driver's ladder"
+        assert plan.S_full == plan.n_short + 1
+        assert plan.S_full in plan.S_variants()
+
+    def test_chunk_ladder_is_the_bucket_chunks_image(self):
+        from proovread_tpu.pipeline.dcorrect import _bucket_chunks
+        ladder = shapes.chunk_ladder(32)
+        assert ladder == [1, 2, 3, 4, 6, 8, 12, 16, 24, 32]
+        assert all(_bucket_chunks(v) == v for v in ladder)
+
+
+class TestPredictor:
+    def test_predicted_superset_of_recorded_config4_ledger(self):
+        """THE acceptance pin: predicted ⊇ observed on the committed
+        config-4 compile ledger, with zero itemized misses."""
+        assert os.path.exists(LEDGER), \
+            "LEDGER_r12_config4.jsonl must stay committed (the " \
+            "reconciliation target of make static-check)"
+        pred = predict.predict_config(4)
+        observed = predict.load_ledger_programs(LEDGER)
+        assert set(observed) >= {"fused_pass", "fused_iterations",
+                                 "assemble_rows"}
+        rec = predict.reconcile(pred, observed)
+        assert rec["ok"], rec["missing"]
+        assert rec["missing"] == [] and rec["unmodeled"] == []
+
+    def test_reconcile_negative_itemizes_misses(self):
+        pred = {"programs": {"fused_pass": ["aaa"]}}
+        rec = predict.reconcile(
+            pred, {"fused_pass": ["aaa", "bbb"], "mystery_entry": ["x"]})
+        assert not rec["ok"]
+        assert {"entry": "fused_pass", "kind": "signature",
+                "sig": "bbb"} in rec["missing"]
+        assert rec["unmodeled"] == ["mystery_entry"], \
+            "an unmodeled observed entry must be itemized, not dropped"
+
+    def test_reconcile_salted_entries_compare_by_count(self):
+        pred = {"programs": {"dmesh:step": ["v0.x"]}}
+        ok = predict.reconcile(pred, {"dmesh:step": ["v7.y"]})
+        assert ok["ok"], "salted sigs differ per process — count compare"
+        bad = predict.reconcile(pred, {"dmesh:step": ["v7.y", "v8.z"]})
+        assert not bad["ok"] and bad["missing"][0]["kind"] == "count"
+
+    def test_sampled_configs_enumerate_every_sel_slab_size(self):
+        """Superset invariant under sampling (code-review finding): when
+        the sampler can fire, the driver sizes `sels` (and the chunk
+        cap) from the 512-rounded max SAMPLED selection length, which
+        rotates per pass — the predictor must enumerate every
+        512-multiple AND keep the full-set variant reachable."""
+        plan = shapes.build_plan(4)
+        # force a sampling-capable coverage without rebuilding workloads
+        plan.coverage = plan.pc.sr_coverage / 0.8 + 1
+        b = plan.buckets[0]
+        # sels is positional arg 9 of the fused_iterations call recipe
+        cols = {args[9].shape[1]
+                for _e, args, _kw in predict._recipe_fused_iterations(
+                    plan, b, True)}
+        assert 1 in cols, "full-set variant must stay reachable"
+        assert set(plan.sampled_S()) <= cols, \
+            f"sampled sel widths missing: {cols}"
+
+    def test_ledger_backend_drives_interpret(self, tmp_path):
+        """A TPU-recorded ledger must reconcile against an
+        interpret=False prediction (the flag is part of the compile
+        key; code-review finding)."""
+        from proovread_tpu.obs import compilecache as cc
+        led = cc.Ledger(backend="tpu")
+        led.call_end(led.call_begin("e", "s"))
+        path = str(tmp_path / "led.jsonl")
+        led.write_jsonl(path)
+        assert predict.ledger_backend(path) == "tpu"
+        assert predict.interpret_for_backend("tpu") is False
+        assert predict.interpret_for_backend("cpu") is True
+        p_cpu = predict.predict_config(4, interpret=True)
+        p_tpu = predict.predict_config(4, interpret=False)
+        assert p_cpu["programs"] != p_tpu["programs"], \
+            "interpret must change every signature"
+        assert p_cpu["by_entry"] == p_tpu["by_entry"], \
+            "…but never the predicted counts (the budget is " \
+            "interpret-invariant)"
+
+    def test_load_ledger_programs_reads_retrace_rows(self, tmp_path):
+        from proovread_tpu.obs import compilecache as cc
+        led = cc.Ledger(backend="cpu")
+        tok = led.call_begin("my_entry", "sig1")
+        led.call_end(tok)
+        led.call_begin("my_entry", "sig1")          # tracing hit: no row
+        path = str(tmp_path / "led.jsonl")
+        led.write_jsonl(path)
+        assert predict.load_ledger_programs(path) == \
+            {"my_entry": ["sig1"]}
+
+    def test_signature_matches_compilecache_hash_for_specs(self):
+        """ShapeDtypeStruct recipe leaves must hash identically to real
+        arrays of the same shape/dtype — the whole predictor rests on
+        this equality."""
+        from proovread_tpu.obs import compilecache as cc
+        arr = jnp.zeros((4, 8), jnp.int8)
+        spec = sds((4, 8), np.int8)
+        kw = dict(m=4, flag=True)
+        assert cc.signature((arr,), kw) == cc.signature((spec,), kw)
+
+
+class TestBudgetGate:
+    def _pred(self, n_fused_pass=3):
+        return {"config": 4, "n_programs": n_fused_pass + 1,
+                "by_entry": {"fused_pass": n_fused_pass,
+                             "assemble_rows": 1}}
+
+    def _budget(self, cap):
+        return {"schema": 1, "budgets": {
+            "config4": {"fused_pass": cap, "assemble_rows": 1}}}
+
+    def test_budget_bump_is_a_breach(self):
+        bc = predict.budget_check(self._pred(4), self._budget(3))
+        assert not bc["ok"]
+        assert bc["breaches"][0]["entry"] == "fused_pass"
+
+    def test_budget_at_cap_passes(self):
+        bc = predict.budget_check(self._pred(3), self._budget(3))
+        assert bc["ok"] and bc["breaches"] == []
+
+    def test_new_entry_without_budget_line_is_a_breach(self):
+        pred = self._pred(3)
+        pred["by_entry"]["brand_new_entry"] = 1
+        bc = predict.budget_check(pred, self._budget(3))
+        assert not bc["ok"]
+        assert any(b["entry"] == "brand_new_entry"
+                   for b in bc["breaches"])
+
+    def test_shrinkage_is_reported_for_ratcheting_down(self):
+        bc = predict.budget_check(self._pred(2), self._budget(3))
+        assert bc["ok"]
+        assert bc["shrinkable"]["fused_pass"] == {"predicted": 2,
+                                                  "budget": 3}
+
+    def test_missing_pool_is_a_breach(self):
+        bc = predict.budget_check(self._pred(3),
+                                  {"schema": 1, "budgets": {}})
+        assert not bc["ok"]
+
+    def test_committed_budget_matches_current_predictions(self):
+        """The committed budget file must stay exactly ratcheted: the
+        live predictor neither exceeds it (breach) nor undercuts it
+        (stale slack) for config 4."""
+        pred = predict.predict_config(4)
+        bc = predict.budget_check(pred, predict.load_budget())
+        assert bc["ok"], bc["breaches"]
+        assert bc["shrinkable"] == {}, \
+            f"ratchet the committed budget down: {bc['shrinkable']}"
+
+
+# --------------------------------------------------------------------------
+# the gate CLI (rc plumbing, monkeypatched cheap)
+# --------------------------------------------------------------------------
+
+class TestCheckCommand:
+    def _run(self, monkeypatch, violations=(), budgets=None,
+             observed=None, errors=()):
+        from proovread_tpu.analysis import __main__ as cli
+        pred = {"schema": 1, "config": 4, "cap_bases": None,
+                "interpret": True, "plan": {},
+                "programs": {"fused_pass": ["s1"]},
+                "by_entry": {"fused_pass": 1}, "n_programs": 1}
+        monkeypatch.setattr(cli, "_collect_violations",
+                            lambda: (list(violations), list(errors)))
+        monkeypatch.setattr(predict, "predict_config",
+                            lambda *a, **k: dict(pred))
+        monkeypatch.setattr(
+            predict, "load_budget",
+            lambda *a: budgets if budgets is not None else
+            {"schema": 1, "budgets": {"config4": {"fused_pass": 1}}})
+        monkeypatch.setattr(predict, "load_ledger_programs",
+                            lambda p: observed if observed is not None
+                            else {"fused_pass": ["s1"]})
+        monkeypatch.setattr(engine, "load_baseline",
+                            lambda p=None: {"schema": 1, "violations": {}})
+        return cli.main(["check", "--configs", "4",
+                         "--ledger", LEDGER])
+
+    def test_clean_tree_rc0(self, monkeypatch, capsys):
+        assert self._run(monkeypatch) == 0
+
+    def test_new_violation_rc1(self, monkeypatch, capsys):
+        v = engine.Violation("no-gather", "entry:x", "scan0", "boom")
+        assert self._run(monkeypatch, violations=[v]) == 1
+
+    def test_budget_bump_rc1(self, monkeypatch, capsys):
+        bad = {"schema": 1, "budgets": {"config4": {"fused_pass": 0}}}
+        assert self._run(monkeypatch, budgets=bad) == 1
+
+    def test_reconcile_miss_rc1(self, monkeypatch, capsys):
+        assert self._run(
+            monkeypatch, observed={"fused_pass": ["sX"]}) == 1
+
+    def test_trace_error_rc1(self, monkeypatch, capsys):
+        assert self._run(monkeypatch, errors=["entry:x: boom"]) == 1
