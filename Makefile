@@ -14,9 +14,12 @@
 #   make accuracy-record  score truth-sidecar CLI runs (config-3 slice,
 #                     config 4, the 4-way dmesh workload) into ACCURACY rows
 #   make accuracy-check   identity floor + no-regression gate over ACCURACY_*.json
+#   make load-smoke   2-replica fleet under hostile traffic: mid-wave kill
+#                     + journal handoff + overload wall, LOAD row per scenario
+#   make load-check   fleet SLO regression gate over the LOAD_*.json history
 #   make bench        the benchmark itself (one JSON row on stdout)
 
-.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke perf-check perf-report prewarm compile-check accuracy-record accuracy-check static-check bench
+.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke load-smoke load-check perf-check perf-report prewarm compile-check accuracy-record accuracy-check static-check bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -78,6 +81,32 @@ serve-smoke:
 dmesh-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 		python -m proovread_tpu.parallel.smoke
+
+# fleet load tier (docs/SERVING.md "Fleet" / docs/OBSERVABILITY.md "Load
+# scoreboard"): a 2-replica CPU fleet (shared compile ledger — replica 1
+# warms from replica 0's programs) under the seeded `slam` scenario —
+# every traffic family incl. ONT, Poisson+burst arrivals, poison jobs
+# that must each bounce with their exact expected reason, and an
+# injected replica_death mid-stream whose journaled jobs hand off to the
+# survivor with every fleet accounting identity intact — then the
+# `overload` wall, which must be answered by bounded rejections from the
+# closed vocabulary, not collapse. LeakCheck at exit; one strict-schema
+# LOAD row per scenario appends to $(LOAD_OUT).
+# Usage: make load-smoke [LOAD_OUT=LOAD_record.json] [REPLICAS=2]
+LOAD_OUT ?= LOAD_record.json
+REPLICAS ?= 2
+load-smoke:
+	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.load smoke \
+		--out $(LOAD_OUT) --replicas $(REPLICAS)
+
+# fleet SLO regression gate: every (scenario, n_replicas, backend) pool's
+# newest LOAD_*.json row must validate (schema + the three fleet
+# accounting identities), carry zero orphaned jobs, show per-family
+# uplift, and stay within thresholds of its rolling baseline for fleet
+# throughput, per-length-class p99 and per-family identity. Exits 1 and
+# prints LOAD-REGRESSION lines on any breach.
+load-check:
+	python -m proovread_tpu.obs.load check
 
 # perf-regression gate (docs/OBSERVABILITY.md): newest usable BENCH row vs
 # a rolling baseline — headline bases/sec, wall, and per-phase deltas.

@@ -121,6 +121,74 @@ def simulate_long_reads(
     return records, truth
 
 
+def _ont_errors(src: np.ndarray, rng, sub: float, ins: float,
+                dele: float, hp_compress: float) -> np.ndarray:
+    """ONT error engine: homopolymer-compression deletions first (each
+    base equal to its predecessor is dropped with prob ``hp_compress`` —
+    the nanopore dwell-time ambiguity that systematically shortens
+    homopolymer runs), then the generic indel/sub engine on the
+    compressed sequence. The caller's truth stays the UNcompressed
+    source — the compression is an error to be corrected, not a feature
+    of the molecule."""
+    if hp_compress > 0.0 and len(src) > 1:
+        same = np.zeros(len(src), bool)
+        same[1:] = src[1:] == src[:-1]
+        drop = same & (rng.random(len(src)) < hp_compress)
+        src = src[~drop]
+    return _apply_errors(src, rng, sub, ins, dele)
+
+
+def simulate_ont_reads(
+    genome: np.ndarray,
+    total_bases: int,
+    mean_len: int = 6000,
+    min_len: int = 500,
+    sub: float = 0.012,
+    ins: float = 0.025,
+    dele: float = 0.045,
+    hp_compress: float = 0.2,
+    qual: int = 12,
+    seed: int = 5,
+    id_prefix: str = "ont",
+):
+    """ONT-profile long reads totalling ~``total_bases``.
+
+    Same contract as :func:`simulate_long_reads` — returns ``(records,
+    truth)`` with truth[i] the error-free source codes oriented as the
+    read, so ``write_truth_sidecar`` and standalone ``--truth`` runs
+    work unchanged — but with the nanopore error profile instead of the
+    CLR one: **indel-dominated** (deletions dominate every other class
+    and indels together far outweigh substitutions — the R9/R10
+    systematics) plus **homopolymer-compression** deletions
+    on top (``hp_compress`` per repeated base; on a random genome ~25%
+    of positions repeat their predecessor, so the default adds ~5%
+    deletion load concentrated in runs). tests/test_fleet.py asserts the
+    residual sub/ins/del mix through ``obs/accuracy.py:edit_alignment``,
+    exercising PR-10's residual-class scoreboard with a second error
+    regime."""
+    rng = np.random.default_rng(seed)
+    G = len(genome)
+    records, truth = [], []
+    tot = 0
+    i = 0
+    while tot < total_bases:
+        ln = int(np.clip(rng.lognormal(np.log(mean_len), 0.55), min_len,
+                         G - 1))
+        a = int(rng.integers(0, G - ln))
+        src = genome[a:a + ln]
+        mut = _ont_errors(src, rng, sub, ins, dele, hp_compress)
+        if rng.random() < 0.5:
+            mut = revcomp_codes(mut)
+            src = revcomp_codes(src)
+        records.append(SeqRecord(
+            f"{id_prefix}_{i}", decode_codes(mut),
+            qual=np.full(len(mut), qual, np.uint8)))
+        truth.append(src)
+        tot += ln
+        i += 1
+    return records, truth
+
+
 def simulate_short_reads(
     genome: np.ndarray,
     coverage: float,

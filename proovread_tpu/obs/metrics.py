@@ -32,6 +32,7 @@ Serialized shape (``schema`` guards readers)::
 from __future__ import annotations
 
 import json
+import threading
 from contextlib import contextmanager
 from typing import Any, Dict, Optional, Tuple
 
@@ -193,52 +194,60 @@ class MetricsRegistry:
             fh.write("\n")
 
 
-_current: Optional[MetricsRegistry] = None
+# Two-level installation: install() is process-global (a CLI installs
+# once, every thread of the run sees it), scope() is THREAD-local — an
+# in-process fleet (serve/fleet.py) runs one wave per replica worker
+# thread concurrently, and a global scope would interleave replica A's
+# wave metrics into replica B's registry. A thread's scope shadows the
+# global install for that thread only.
+_installed: Optional[MetricsRegistry] = None
+_tls = threading.local()
 
 
 def current() -> Optional[MetricsRegistry]:
-    return _current
+    reg = getattr(_tls, "reg", None)
+    return reg if reg is not None else _installed
 
 
 def install(reg: Optional[MetricsRegistry] = None) -> MetricsRegistry:
-    global _current
-    _current = reg if reg is not None else MetricsRegistry()
-    return _current
+    global _installed
+    _installed = reg if reg is not None else MetricsRegistry()
+    return _installed
 
 
 def uninstall() -> None:
-    global _current
-    _current = None
+    global _installed
+    _installed = None
 
 
 @contextmanager
 def scope(registry: Optional[MetricsRegistry] = None):
     """Yield the active registry, or install a fresh (or given) one for
-    the block. ``Pipeline.run`` wraps itself in this so CLI-installed
-    registries accumulate across stages while bare programmatic runs
-    still get per-run metrics."""
-    global _current
-    if registry is None and _current is not None:
-        yield _current
+    the block — in THIS thread only. ``Pipeline.run`` wraps itself in
+    this so CLI-installed registries accumulate across stages while bare
+    programmatic runs still get per-run metrics."""
+    cur = current()
+    if registry is None and cur is not None:
+        yield cur
         return
-    prev = _current
-    _current = registry if registry is not None else MetricsRegistry()
+    prev = getattr(_tls, "reg", None)
+    _tls.reg = registry if registry is not None else MetricsRegistry()
     try:
-        yield _current
+        yield _tls.reg
     finally:
-        _current = prev
+        _tls.reg = prev
 
 
 def counter(name: str, unit: str = "", help: str = ""):      # noqa: A002
-    return (_current.counter(name, unit, help)
-            if _current is not None else NOOP)
+    reg = current()
+    return reg.counter(name, unit, help) if reg is not None else NOOP
 
 
 def gauge(name: str, unit: str = "", help: str = ""):        # noqa: A002
-    return (_current.gauge(name, unit, help)
-            if _current is not None else NOOP)
+    reg = current()
+    return reg.gauge(name, unit, help) if reg is not None else NOOP
 
 
 def histogram(name: str, unit: str = "", help: str = ""):    # noqa: A002
-    return (_current.histogram(name, unit, help)
-            if _current is not None else NOOP)
+    reg = current()
+    return reg.histogram(name, unit, help) if reg is not None else NOOP

@@ -50,6 +50,7 @@ drift from the schema (tests/test_qc.py::TestQcSchema::test_schema_never_drifts)
 from __future__ import annotations
 
 import json
+import threading
 from contextlib import contextmanager
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
@@ -434,39 +435,45 @@ class QcRecorder:
 
 # -- module-level installation (mirrors obs.metrics) -----------------------
 
-_current: Optional[QcRecorder] = None
+# install() is process-global, scope() is thread-local — the same
+# two-level discipline as obs.metrics: an in-process fleet runs replica
+# waves in concurrent worker threads, each under its own QC recorder
+_installed: Optional[QcRecorder] = None
+_tls = threading.local()
 
 
 def current() -> Optional[QcRecorder]:
-    return _current
+    rec = getattr(_tls, "rec", None)
+    return rec if rec is not None else _installed
 
 
 def enabled() -> bool:
-    return _current is not None
+    return current() is not None
 
 
 def install(rec: Optional[QcRecorder] = None) -> QcRecorder:
-    global _current
-    _current = rec if rec is not None else QcRecorder()
-    return _current
+    global _installed
+    _installed = rec if rec is not None else QcRecorder()
+    return _installed
 
 
 def uninstall() -> None:
-    global _current
-    _current = None
+    global _installed
+    _installed = None
 
 
 @contextmanager
 def scope(rec: Optional[QcRecorder] = None):
     """Yield the active recorder, or install a fresh (or given) one for
-    the block — same reuse semantics as ``obs.metrics.scope``."""
-    global _current
-    if rec is None and _current is not None:
-        yield _current
+    the block in THIS thread — same reuse semantics as
+    ``obs.metrics.scope``."""
+    cur = current()
+    if rec is None and cur is not None:
+        yield cur
         return
-    prev = _current
-    _current = rec if rec is not None else QcRecorder()
+    prev = getattr(_tls, "rec", None)
+    _tls.rec = rec if rec is not None else QcRecorder()
     try:
-        yield _current
+        yield _tls.rec
     finally:
-        _current = prev
+        _tls.rec = prev

@@ -767,6 +767,275 @@ def validate_slo(path: str, require_drained: bool = False
             "rejections": sum(rej.values())}
 
 
+# -- fleet LOAD artifact schema (obs/load.py writer) ------------------------
+# One row per recorded fleet run. Declared here, independently of the
+# writer, validated two-sidedly (missing AND undeclared fields fail) —
+# the same drift discipline as the SLO schema above, extended fleet-wide:
+# the row carries per-replica SLO slices plus the dispatcher's own books,
+# and THREE accounting identities must hold simultaneously (validate_load
+# docstring). A field added to the writer without being declared here is
+# a test failure, and vice versa.
+LOAD_SCHEMA_VERSION = 1
+LOAD_ROW_FIELDS = ("load_schema", "scenario", "n_replicas", "backend",
+                   "wall_s", "bases_per_sec_fleet", "jobs", "rejections",
+                   "latency", "queue", "demotions", "accuracy", "handoff",
+                   "heartbeat", "compile", "replicas")
+LOAD_JOB_KEYS = ("routed", "rejected", "rejected_fleet", "handoffs",
+                 "orphaned", "accepted", "completed", "failed",
+                 "cancelled", "expired", "journaled")
+LOAD_HANDOFF_KEYS = ("deaths", "handoffs", "orphaned")
+LOAD_HEARTBEAT_KEYS = ("samples", "replicas_seen")
+LOAD_ACCURACY_KEYS = ("n_scored", "identity_before", "identity_after",
+                      "identity_after_min")
+LOAD_REPLICA_KEYS = ("replica_id", "alive", "dead_reason", "drain_clean",
+                     "jobs")
+LOAD_COMPILE_KEYS = ("n_programs", "backend_compiles",
+                     "tracing_hit_rate")
+# replica-summed per-status counters on the fleet jobs section (the
+# remaining LOAD_JOB_KEYS are dispatcher-side uniques)
+LOAD_SUMMED_KEYS = ("accepted", "completed", "failed", "cancelled",
+                    "expired", "journaled")
+
+
+def validate_load(row: Any, where: str = "LOAD row") -> Dict[str, Any]:
+    """Strictly validate one fleet LOAD row (``obs/load.py`` writer).
+
+    Beyond the two-sided schema check, three accounting identities must
+    hold — together they pin *zero jobs lost fleet-wide, through
+    replica death and journal handoff*:
+
+    A. ``accepted == completed+failed+cancelled+expired+journaled``
+       (replica-summed: each replica's own SLO identity, summed — a
+       handoff self-balances, +1 accepted at the survivor, +1 stale
+       journaled entry at the dead replica);
+    B. ``accepted == routed + handoffs`` (the dispatcher's unique-job
+       books vs the replicas' accept counters — a double-counted or
+       phantom accept breaks this side);
+    C. ``journaled == handoffs + orphaned`` (every stale journal entry
+       is attributable: either resubmitted to a survivor or an explicit
+       orphan — a dropped handoff breaks this side).
+
+    Per-replica slices are validated too (identity A per replica, sums
+    reconciled against the fleet section). Raises ValidationError;
+    returns a small summary on success."""
+    if not isinstance(row, dict):
+        _fail(f"{where}: not an object")
+    if row.get("load_schema") != LOAD_SCHEMA_VERSION:
+        _fail(f"{where}: load_schema != {LOAD_SCHEMA_VERSION}")
+    unknown = [k for k in row if k not in LOAD_ROW_FIELDS]
+    missing = [k for k in LOAD_ROW_FIELDS if k not in row]
+    if unknown or missing:
+        _fail(f"{where}: undeclared fields {unknown} / missing {missing} "
+              "— declare in obs/validate.py:LOAD_ROW_FIELDS first")
+    if not isinstance(row["scenario"], str) or not row["scenario"]:
+        _fail(f"{where}: scenario must be a non-empty string")
+    n_rep = row["n_replicas"]
+    if not isinstance(n_rep, int) or n_rep < 1:
+        _fail(f"{where}: n_replicas must be a >=1 int")
+    if not isinstance(row["backend"], str) or not row["backend"]:
+        _fail(f"{where}: backend must be a non-empty string")
+    if not isinstance(row["wall_s"], _NUM) or row["wall_s"] <= 0:
+        _fail(f"{where}: wall_s must be a positive number")
+    bps = row["bases_per_sec_fleet"]
+    if not isinstance(bps, _NUM) or bps < 0:
+        _fail(f"{where}: bases_per_sec_fleet must be a >=0 number")
+
+    jobs = row["jobs"]
+    if not isinstance(jobs, dict) or sorted(jobs) != sorted(LOAD_JOB_KEYS):
+        _fail(f"{where}: jobs must have exactly keys {LOAD_JOB_KEYS}")
+    for k, v in jobs.items():
+        if not isinstance(v, int) or v < 0:
+            _fail(f"{where}: jobs.{k} must be a >=0 int")
+    terminal = sum(jobs[k] for k in ("completed", "failed", "cancelled",
+                                     "expired", "journaled"))
+    if jobs["accepted"] != terminal:
+        _fail(f"{where}: identity A broken — accepted {jobs['accepted']} "
+              f"!= completed+failed+cancelled+expired+journaled "
+              f"{terminal} (a job was silently lost or double-counted)")
+    if jobs["accepted"] != jobs["routed"] + jobs["handoffs"]:
+        _fail(f"{where}: identity B broken — accepted {jobs['accepted']} "
+              f"!= routed {jobs['routed']} + handoffs "
+              f"{jobs['handoffs']} (dispatcher books and replica "
+              "counters disagree)")
+    if jobs["journaled"] != jobs["handoffs"] + jobs["orphaned"]:
+        _fail(f"{where}: identity C broken — journaled "
+              f"{jobs['journaled']} != handoffs {jobs['handoffs']} + "
+              f"orphaned {jobs['orphaned']} (a stale journal entry is "
+              "unattributed)")
+
+    rej = row["rejections"]
+    if not isinstance(rej, dict):
+        _fail(f"{where}: rejections must be an object")
+    bad = [k for k in rej if k not in SLO_REJECT_REASONS]
+    if bad:
+        _fail(f"{where}: rejection reasons {bad} outside the closed "
+              f"vocabulary {SLO_REJECT_REASONS}")
+    for k, v in rej.items():
+        if not isinstance(v, int) or v < 0:
+            _fail(f"{where}: rejections.{k} must be a >=0 int")
+    if sum(rej.values()) != jobs["rejected"]:
+        _fail(f"{where}: jobs.rejected {jobs['rejected']} != sum of "
+              f"per-reason rejections {sum(rej.values())}")
+
+    lat = row["latency"]
+    if not isinstance(lat, dict):
+        _fail(f"{where}: latency must be an object")
+    for cls, lrow in lat.items():
+        if not isinstance(lrow, dict) or \
+                sorted(lrow) != sorted(SLO_LATENCY_KEYS):
+            _fail(f"{where}: latency[{cls!r}] must have exactly keys "
+                  f"{SLO_LATENCY_KEYS}")
+        if not isinstance(lrow["count"], int) or lrow["count"] <= 0:
+            _fail(f"{where}: latency[{cls!r}].count must be a positive "
+                  "int")
+        for k in ("p50_s", "p99_s", "max_s"):
+            if not isinstance(lrow[k], _NUM) or lrow[k] < 0:
+                _fail(f"{where}: latency[{cls!r}].{k} must be a >=0 "
+                      "number")
+        if not lrow["p50_s"] <= lrow["p99_s"] <= lrow["max_s"]:
+            _fail(f"{where}: latency[{cls!r}] percentiles not monotonic")
+    n_lat = sum(v["count"] for v in lat.values())
+    if n_lat != jobs["completed"]:
+        _fail(f"{where}: latency counts sum to {n_lat} but "
+              f"jobs.completed is {jobs['completed']} — a completed "
+              "job's latency went unmeasured (or was double-measured)")
+
+    q = row["queue"]
+    if not isinstance(q, dict) or sorted(q) != sorted(SLO_QUEUE_KEYS):
+        _fail(f"{where}: queue must have exactly keys {SLO_QUEUE_KEYS}")
+    for k in SLO_QUEUE_KEYS:
+        if not isinstance(q[k], int) or q[k] < 0:
+            _fail(f"{where}: queue.{k} must be a >=0 int")
+
+    dem = row["demotions"]
+    if not isinstance(dem, dict) or any(
+            not isinstance(v, int) or v < 0 for v in dem.values()):
+        _fail(f"{where}: demotions must map tenant -> >=0 int")
+
+    acc = row["accuracy"]
+    if not isinstance(acc, dict):
+        _fail(f"{where}: accuracy must be an object")
+    for fam, arow in acc.items():
+        if not isinstance(arow, dict) or \
+                sorted(arow) != sorted(LOAD_ACCURACY_KEYS):
+            _fail(f"{where}: accuracy[{fam!r}] must have exactly keys "
+                  f"{LOAD_ACCURACY_KEYS}")
+        if not isinstance(arow["n_scored"], int) or arow["n_scored"] < 1:
+            _fail(f"{where}: accuracy[{fam!r}].n_scored must be a "
+                  "positive int")
+        for k in ("identity_before", "identity_after",
+                  "identity_after_min"):
+            if not isinstance(arow[k], _NUM) or not 0 <= arow[k] <= 1:
+                _fail(f"{where}: accuracy[{fam!r}].{k} must be in "
+                      "[0, 1]")
+        if arow["identity_after_min"] > arow["identity_after"]:
+            _fail(f"{where}: accuracy[{fam!r}] min above mean")
+
+    ho = row["handoff"]
+    if not isinstance(ho, dict) or \
+            sorted(ho) != sorted(LOAD_HANDOFF_KEYS):
+        _fail(f"{where}: handoff must have exactly keys "
+              f"{LOAD_HANDOFF_KEYS}")
+    for k in LOAD_HANDOFF_KEYS:
+        if not isinstance(ho[k], int) or ho[k] < 0:
+            _fail(f"{where}: handoff.{k} must be a >=0 int")
+    if ho["handoffs"] != jobs["handoffs"] \
+            or ho["orphaned"] != jobs["orphaned"]:
+        _fail(f"{where}: handoff section disagrees with jobs section")
+    if ho["deaths"] > n_rep:
+        _fail(f"{where}: handoff.deaths {ho['deaths']} exceeds "
+              f"n_replicas {n_rep}")
+
+    hb = row["heartbeat"]
+    if not isinstance(hb, dict) or \
+            sorted(hb) != sorted(LOAD_HEARTBEAT_KEYS):
+        _fail(f"{where}: heartbeat must have exactly keys "
+              f"{LOAD_HEARTBEAT_KEYS}")
+    if not isinstance(hb["samples"], int) or hb["samples"] < 1:
+        _fail(f"{where}: heartbeat.samples must be a >=1 int (a fleet "
+              "run with no heartbeat coverage measured nothing)")
+    seen = hb["replicas_seen"]
+    if not isinstance(seen, list) or not seen or any(
+            not isinstance(s, str) for s in seen):
+        _fail(f"{where}: heartbeat.replicas_seen must be a non-empty "
+              "list of replica ids")
+
+    comp = row["compile"]
+    if not isinstance(comp, dict) or \
+            sorted(comp) != sorted(LOAD_COMPILE_KEYS):
+        _fail(f"{where}: compile must have exactly keys "
+              f"{LOAD_COMPILE_KEYS}")
+    for k in ("n_programs", "backend_compiles"):
+        if not isinstance(comp[k], _NUM) or comp[k] < 0:
+            _fail(f"{where}: compile.{k} must be a >=0 number")
+    thr = comp["tracing_hit_rate"]
+    if thr is not None and (not isinstance(thr, _NUM)
+                            or not 0.0 <= thr <= 1.0):
+        _fail(f"{where}: compile.tracing_hit_rate must be null or in "
+              "[0, 1]")
+
+    reps = row["replicas"]
+    if not isinstance(reps, list) or len(reps) != n_rep:
+        _fail(f"{where}: replicas must be a list of exactly "
+              f"n_replicas={n_rep} slices")
+    sums = {k: 0 for k in LOAD_SUMMED_KEYS}
+    rejected_sum = 0
+    ids = []
+    for i, rs in enumerate(reps):
+        rw = f"{where}: replicas[{i}]"
+        if not isinstance(rs, dict) or \
+                sorted(rs) != sorted(LOAD_REPLICA_KEYS):
+            _fail(f"{rw} must have exactly keys {LOAD_REPLICA_KEYS}")
+        if not isinstance(rs["replica_id"], str) or not rs["replica_id"]:
+            _fail(f"{rw}.replica_id must be a non-empty string")
+        ids.append(rs["replica_id"])
+        if not isinstance(rs["alive"], bool):
+            _fail(f"{rw}.alive must be a bool")
+        if not isinstance(rs["dead_reason"], str):
+            _fail(f"{rw}.dead_reason must be a string")
+        if rs["drain_clean"] is not None and \
+                not isinstance(rs["drain_clean"], bool):
+            _fail(f"{rw}.drain_clean must be null or a bool")
+        rj = rs["jobs"]
+        if not isinstance(rj, dict) or \
+                sorted(rj) != sorted(SLO_JOB_KEYS):
+            _fail(f"{rw}.jobs must have exactly keys {SLO_JOB_KEYS}")
+        for k, v in rj.items():
+            if not isinstance(v, int) or v < 0:
+                _fail(f"{rw}.jobs.{k} must be a >=0 int")
+        accounted = sum(rj[k] for k in ("completed", "failed",
+                                        "cancelled", "expired",
+                                        "journaled"))
+        if rj["accepted"] != accounted:
+            _fail(f"{rw}: per-replica identity broken — accepted "
+                  f"{rj['accepted']} != terminal+journaled {accounted}")
+        for k in LOAD_SUMMED_KEYS:
+            sums[k] += rj[k]
+        rejected_sum += rj["rejected"]
+    for k in LOAD_SUMMED_KEYS:
+        if sums[k] != jobs[k]:
+            _fail(f"{where}: replica-summed jobs.{k} {sums[k]} != fleet "
+                  f"jobs.{k} {jobs[k]}")
+    # rejection reconciliation: jobs.rejected_fleet rejections (fleet-
+    # level duplicate detection) never reach a replica; the rest must
+    # each have been seen server-side. Server-side rejections can still
+    # exceed that floor (a handoff resubmission a draining survivor
+    # bounces is server-visible only).
+    if jobs["rejected_fleet"] > jobs["rejected"]:
+        _fail(f"{where}: jobs.rejected_fleet {jobs['rejected_fleet']} "
+              f"exceeds jobs.rejected {jobs['rejected']}")
+    if rejected_sum < jobs["rejected"] - jobs["rejected_fleet"]:
+        _fail(f"{where}: replicas saw {rejected_sum} rejections but the "
+              f"dispatcher routed "
+              f"{jobs['rejected'] - jobs['rejected_fleet']} to them")
+    unseen = [s for s in seen if s not in ids]
+    if unseen:
+        _fail(f"{where}: heartbeat.replicas_seen {unseen} not in the "
+              "replica slices")
+    return {"jobs": jobs, "n_latency_classes": len(lat),
+            "families": sorted(acc), "deaths": ho["deaths"]}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="proovread-tpu-obs-validate",

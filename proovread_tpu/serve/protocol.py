@@ -20,7 +20,18 @@ Ops::
     cancel  {op, job_id}             -> {ok, status}
     stats   {op}                     -> {ok, slo: {...}}  (SLO snapshot)
     drain   {op}                     -> {ok, draining: true}
-    ping    {op}                     -> {ok, draining: bool}
+    ping    {op}                     -> {ok, draining: bool,
+                                         replica_id: str, uptime_s: num,
+                                         wave: null | {wave, jobs,
+                                                       busy_s}}
+
+The ``ping`` response is the fleet dispatcher's health probe
+(docs/SERVING.md "Fleet"): ``replica_id`` pins identity across a socket
+reconnect, ``uptime_s`` is monotonic since the server was constructed
+(a restart resets it — how the dispatcher notices a silent replace),
+and ``wave`` carries the in-flight wave state so a replica hung in
+compile (``busy_s`` growing) is distinguishable from a healthy idle one
+(``wave: null``).
 
 Records on the wire are ``{"id", "seq", "qual": base64-u8 | null}`` —
 the same qual encoding the checkpoint journal uses, so a journaled job

@@ -82,6 +82,9 @@ class ServeConfig:
     # testing knob: request a drain after N computed buckets (the
     # deterministic stand-in for SIGTERM landing mid-wave)
     drain_after_buckets: Optional[int] = None
+    # stable identity on the ping probe — the fleet dispatcher assigns
+    # "r0".."rN-1"; empty derives a per-process default
+    replica_id: str = ""
 
 
 class CorrectionServer:
@@ -104,6 +107,13 @@ class CorrectionServer:
         self._wake = threading.Condition(self._lock)
         self._drain = threading.Event()
         self._drained = threading.Event()
+        # ping-probe identity (docs/SERVING.md "Fleet"): a monotonic
+        # birth stamp plus the in-flight wave state — what lets the
+        # dispatcher tell a replica hung in compile (wave busy_s
+        # growing, uptime high) from a healthy idle one (wave None)
+        self.replica_id = config.replica_id or f"pid{os.getpid()}"
+        self._born_mono = time.monotonic()
+        self._wave_state: Optional[Dict[str, Any]] = None
         self._jobs: Dict[str, Job] = {}
         self._queue: List[str] = []          # job ids, submission order
         self._submit_seq = 0
@@ -244,8 +254,24 @@ class CorrectionServer:
             self.drain()
             return {"ok": True, "draining": True}
         if op == "ping":
-            return {"ok": True, "draining": self._drain.is_set()}
+            return self._op_ping()
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _op_ping(self) -> Dict[str, Any]:
+        """Liveness/health probe: replica identity, monotonic uptime and
+        the in-flight wave state. ``wave`` is None when idle; a busy
+        replica reports which wave, how many jobs ride it, and how long
+        it has been computing — so the dispatcher can distinguish
+        hung-in-compile (busy_s growing without bound) from healthy-idle
+        (wave None) without touching the job table."""
+        with self._lock:
+            ws = dict(self._wave_state) if self._wave_state else None
+        if ws is not None:
+            ws["busy_s"] = round(time.monotonic() - ws.pop("t0"), 6)
+        return {"ok": True, "draining": self._drain.is_set(),
+                "replica_id": self.replica_id,
+                "uptime_s": round(time.monotonic() - self._born_mono, 6),
+                "wave": ws}
 
     def _reject(self, reason: str, retry_after_s: Optional[float] = None,
                 detail: str = "") -> Dict[str, Any]:
@@ -482,11 +508,17 @@ class CorrectionServer:
         d0 = sum(self.registry.counter("resilience_demotions",
                                        "demotions").series.values())
         t0 = time.monotonic()
+        with self._lock:
+            self._wave_state = {"wave": wave, "jobs": len(batch),
+                                "t0": t0}
         try:
             outcome = self.waves.run_wave(wave, batch, self._finalize)
         except Exception as e:                # noqa: BLE001 — wave death
             self._wave_died(batch, e)
             return True
+        finally:
+            with self._lock:
+                self._wave_state = None
         dt = time.monotonic() - t0
         done_bases = sum(j.n_bases for j in batch if j.terminal)
         self.admission.observe_rate(done_bases, dt)

@@ -17,12 +17,14 @@ Spec grammar (semicolon- or comma-separated rules)::
     <kind>@b<bucket>[.p<pass>][x<count>]        device-site rules
     <kind>@j<job>[x<count>]                     job-site rules (serving)
     <kind>@d<shard>[.p<pass>][x<count>]         mesh-site rules (multi-chip)
+    <kind>@r<replica>[.j<ordinal>][x<count>]    fleet-site rules (dispatcher)
     <kind>@*[.p<pass>][x<count>]
 
     kind    device sites: compile | oom | timeout | kernel
             job sites:    parse | worker | deadline | quota | journal
             mesh sites:   device_lost | shard_oom | straggler |
                           collective_timeout
+            fleet sites:  replica_death | stalled_drain | dispatch_timeout
     bucket  0-based length-bucket index ('*' = any bucket)
     job     0-based job SUBMISSION ordinal within one server lifetime
             ('*' = any job); only valid for the job-site kinds
@@ -30,10 +32,19 @@ Spec grammar (semicolon- or comma-separated rules)::
             shard); only valid for the mesh-site kinds. A shard the mesh
             ladder already dropped is never visited again, so an
             unlimited rule cannot loop the shrink rung forever.
+    replica 0-based replica index in the fleet ('*' = any alive replica);
+            only valid for the fleet-site kinds. A replica the dispatcher
+            already declared dead is never probed again, mirroring the
+            dropped-shard rule above.
     pass    1..n_iterations; n_iterations+1 addresses the finish pass.
             Omitted = the rule fires at ANY device site of the bucket,
             including the bucket-entry site. For mesh sites: the
             iteration whose sharded step the fault interrupts.
+    ordinal 0-based DISPATCH ordinal within one fleet lifetime — the
+            fleet fault fires when the dispatcher routes its
+            ``ordinal``-th job at/through the addressed replica. Omitted
+            = the rule fires at the replica's next probed fleet site.
+            Only valid for the fleet-site kinds.
     count   max number of firings (default: unlimited — a rule keeps
             firing on every ladder retry, which is what walks a bucket
             down to the host-scan rung)
@@ -43,7 +54,10 @@ device attempt), ``oom@b1`` (OOM on any device work in bucket 1),
 ``timeout@b2.p1x1`` (one single injected timeout), ``worker@j3x1`` (the
 correction worker dies once while a wave containing job 3 is mid-flight),
 ``device_lost@d1.p2`` (shard 1's chip dies at iteration 2 of every mesh
-attempt — the headline ``make dmesh-smoke`` scenario).
+attempt — the headline ``make dmesh-smoke`` scenario),
+``replica_death@r1.j5`` (replica 1 is killed mid-wave when the
+dispatcher routes its 5th job — the headline ``make load-smoke``
+handoff scenario).
 
 Device faults are only raised from device-path sites, so the host
 ``engine="scan"`` rung — and the scan engine itself — always completes,
@@ -75,6 +89,7 @@ KINDS = ("compile", "oom", "timeout", "kernel")
 JOB_KINDS = ("parse", "worker", "deadline", "quota", "journal")
 MESH_KINDS = ("device_lost", "shard_oom", "straggler",
               "collective_timeout")
+FLEET_KINDS = ("replica_death", "stalled_drain", "dispatch_timeout")
 
 
 class InjectedFault(RuntimeError):
@@ -205,6 +220,49 @@ class InjectedJournalCorruption(InjectedJobFault):
     disk corruption; atomic writes cannot prevent it)."""
 
 
+class InjectedFleetFault(InjectedJobFault):
+    """Base class for injected FLEET faults (``@r<replica>`` sites).
+    Subclasses :class:`InjectedJobFault` — NOT RuntimeError — for the
+    same reason the job sites do: ``resilience.classify_fault`` returns
+    ``None``, so the device degradation ladder inside a replica's wave
+    can never absorb a dispatcher-layer fault. Carries the addressed
+    ``replica`` and its ``kind`` so the dispatcher can attribute the
+    effect (kill / stall / timeout) to the right replica."""
+
+    kind = "fleet"
+
+    def __init__(self, *args, replica=None):
+        super().__init__(*args)
+        self.replica = replica
+
+
+class InjectedReplicaDeath(InjectedFleetFault):
+    """Stands in for a replica process dying mid-wave (OOM-killer,
+    ``kill -9``, kernel panic): the socket goes dark with jobs in
+    flight. The dispatcher must detect the death at its next probe and
+    hand the replica's journaled non-terminal jobs to survivors."""
+
+    kind = "replica_death"
+
+
+class InjectedStalledDrain(InjectedFleetFault):
+    """Stands in for a replica whose graceful drain never finishes (a
+    wave hung in a collective, a wedged worker thread): the dispatcher's
+    bounded drain-wait must expire and escalate to a kill + handoff
+    rather than wait forever."""
+
+    kind = "stalled_drain"
+
+
+class InjectedDispatchTimeout(InjectedFleetFault):
+    """Stands in for one dispatcher-visible request timeout (transient
+    socket stall, replica busy past the probe deadline) — the dispatcher
+    must count it against the replica's health, not crash, and not
+    declare death on a single blip."""
+
+    kind = "dispatch_timeout"
+
+
 class WallClockExceeded(Exception):
     """A RUN-level wall budget breach (``bench.py --wall-budget``).
 
@@ -214,7 +272,19 @@ class WallClockExceeded(Exception):
     result), not demote the bucket and keep going unbounded."""
 
 
-def make_fault(kind: str, where: str, shard=None) -> Exception:
+def make_fault(kind: str, where: str, shard=None, replica=None) -> Exception:
+    if kind == "replica_death":
+        return InjectedReplicaDeath(
+            f"replica {replica} died (injected at {where})",
+            replica=replica)
+    if kind == "stalled_drain":
+        return InjectedStalledDrain(
+            f"replica {replica} drain stalled (injected at {where})",
+            replica=replica)
+    if kind == "dispatch_timeout":
+        return InjectedDispatchTimeout(
+            f"request to replica {replica} timed out (injected at "
+            f"{where})", replica=replica)
     if kind == "device_lost":
         return InjectedDeviceLost(
             f"device lost: shard {shard} dropped off the mesh "
@@ -261,8 +331,8 @@ def make_fault(kind: str, where: str, shard=None) -> Exception:
 
 _RULE_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@(?:b(?P<bucket>\d+)|j(?P<job>\d+)"
-    r"|d(?P<shard>\d+)|(?P<any>\*))"
-    r"(?:\.p(?P<pass>\d+))?(?:x(?P<count>\d+))?$")
+    r"|d(?P<shard>\d+)|r(?P<replica>\d+)|(?P<any>\*))"
+    r"(?:\.p(?P<pass>\d+)|\.j(?P<jord>\d+))?(?:x(?P<count>\d+))?$")
 
 
 @dataclass
@@ -273,10 +343,13 @@ class FaultRule:
     count: Optional[int]         # None = unlimited firings
     job: Optional[int] = None    # job-site rules: submission ordinal
     shard: Optional[int] = None  # mesh-site rules: original shard ordinal
+    replica: Optional[int] = None  # fleet-site rules: replica index
+    jord: Optional[int] = None   # fleet-site rules: dispatch ordinal
     fired: int = 0
 
     def matches(self, bucket: int, pass_: Optional[int]) -> bool:
-        if self.kind in JOB_KINDS or self.kind in MESH_KINDS:
+        if (self.kind in JOB_KINDS or self.kind in MESH_KINDS
+                or self.kind in FLEET_KINDS):
             return False
         if self.count is not None and self.fired >= self.count:
             return False
@@ -306,6 +379,18 @@ class FaultRule:
             return False
         return True
 
+    def matches_fleet(self, replica: int, jord: Optional[int],
+                      site: str) -> bool:
+        if self.kind != site or self.kind not in FLEET_KINDS:
+            return False
+        if self.count is not None and self.fired >= self.count:
+            return False
+        if self.replica is not None and self.replica != replica:
+            return False
+        if self.jord is not None and self.jord != jord:
+            return False
+        return True
+
 
 @dataclass
 class FaultPlan:
@@ -330,29 +415,45 @@ class FaultPlan:
                     "kinds)")
             kind = m.group("kind")
             if (kind not in KINDS and kind not in JOB_KINDS
-                    and kind not in MESH_KINDS):
+                    and kind not in MESH_KINDS
+                    and kind not in FLEET_KINDS):
                 raise ValueError(
                     f"unknown fault kind {kind!r} in {part!r} "
-                    f"(known: {', '.join(KINDS + JOB_KINDS + MESH_KINDS)})")
+                    f"(known: {', '.join(KINDS + JOB_KINDS + MESH_KINDS + FLEET_KINDS)})")
             if kind in JOB_KINDS and (m.group("bucket") or m.group("pass")
-                                      or m.group("shard")):
+                                      or m.group("shard")
+                                      or m.group("replica")
+                                      or m.group("jord")):
                 raise ValueError(
                     f"job-site kind {kind!r} takes @jN or @* addressing, "
-                    f"not bucket/pass/shard sites ({part!r})")
-            if kind in KINDS and (m.group("job") or m.group("shard")):
+                    f"not bucket/pass/shard/replica sites ({part!r})")
+            if kind in KINDS and (m.group("job") or m.group("shard")
+                                  or m.group("replica")
+                                  or m.group("jord")):
                 raise ValueError(
                     f"device-site kind {kind!r} takes @bN or @* "
-                    f"addressing, not @j/@d sites ({part!r})")
-            if kind in MESH_KINDS and (m.group("bucket") or m.group("job")):
+                    f"addressing, not @j/@d/@r sites ({part!r})")
+            if kind in MESH_KINDS and (m.group("bucket") or m.group("job")
+                                       or m.group("replica")
+                                       or m.group("jord")):
                 raise ValueError(
                     f"mesh-site kind {kind!r} takes @dN or @* addressing, "
-                    f"not @b/@j sites ({part!r})")
+                    f"not @b/@j/@r sites ({part!r})")
+            if kind in FLEET_KINDS and (m.group("bucket") or m.group("job")
+                                        or m.group("shard")
+                                        or m.group("pass")):
+                raise ValueError(
+                    f"fleet-site kind {kind!r} takes @rN[.jM] or @*[.jM] "
+                    f"addressing, not @b/@j/@d or .p sites ({part!r})")
             rules.append(FaultRule(
                 kind=kind,
                 bucket=(int(m.group("bucket")) if m.group("bucket")
                         else None),
                 job=int(m.group("job")) if m.group("job") else None,
                 shard=int(m.group("shard")) if m.group("shard") else None,
+                replica=(int(m.group("replica")) if m.group("replica")
+                         else None),
+                jord=int(m.group("jord")) if m.group("jord") else None,
                 pass_=int(m.group("pass")) if m.group("pass") else None,
                 count=int(m.group("count")) if m.group("count") else None))
         return cls(rules)
@@ -411,6 +512,36 @@ class FaultPlan:
                             r.kind, where, r.fired,
                             f"/{r.count}" if r.count else "")
                 raise make_fault(r.kind, where, shard=shard)
+
+    def fires_fleet(self, replica: int, site: str,
+                    jord: Optional[int] = None) -> bool:
+        """Consume one firing of a fleet-site rule matching ``(replica,
+        jord, site)`` and return True — without raising. The dispatcher
+        uses this form for effects that are actions, not exceptions
+        (killing a replica, skipping a drain forward)."""
+        for r in self.rules:
+            if r.matches_fleet(replica, jord, site):
+                r.fired += 1
+                where = (f"replica {replica}" if jord is None
+                         else f"replica {replica} dispatch ordinal {jord}")
+                log.warning(
+                    "fault injection: %s at %s (rule fired %d%s)",
+                    r.kind, where, r.fired,
+                    f"/{r.count}" if r.count else "")
+                return True
+        return False
+
+    def check_fleet(self, replica: int, site: str,
+                    jord: Optional[int] = None) -> None:
+        """Raise the injected fleet fault if a rule matches this
+        ``(replica, dispatch-ordinal)`` site. Called by the dispatcher
+        for ALIVE replicas only — a replica already declared dead is
+        never probed again, so an unlimited ``@*`` rule cannot loop the
+        handoff path forever (the dropped-shard discipline)."""
+        if self.fires_fleet(replica, site, jord=jord):
+            where = (f"replica {replica}" if jord is None
+                     else f"replica {replica} dispatch ordinal {jord}")
+            raise make_fault(site, where, replica=replica)
 
     def check_span(self, bucket: int, pass_lo: int, pass_hi: int) -> None:
         """Raise if any pass index in ``[pass_lo, pass_hi]`` matches — the

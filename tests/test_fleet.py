@@ -1,0 +1,503 @@
+"""Fleet-layer tests: the replica-scoped fault grammar, the seeded
+traffic generator (including the ONT error-mix contract), the strict
+LOAD-row schema with its three fleet accounting identities, the
+load-check gate's falsifiability, and live dispatcher drills (heartbeat
+probes, single-blip tolerance, unordinaled kill, stalled-drain
+escalation). The heavy end-to-end fleet run — real waves through real
+replicas — is `slow`-marked; everything tier-1 here runs without
+compiling a single program (docs/OBSERVABILITY.md 'Load scoreboard')."""
+
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from proovread_tpu.io.simulate import (random_genome, simulate_ont_reads,
+                                       simulate_short_reads)
+from proovread_tpu.obs.accuracy import edit_alignment
+from proovread_tpu.obs.load import (FleetScoreboard, load_check,
+                                    load_rows)
+from proovread_tpu.obs.validate import (LOAD_ROW_FIELDS, ValidationError,
+                                        validate_load)
+from proovread_tpu.serve.fleet import FleetConfig, FleetDispatcher
+from proovread_tpu.serve.loadgen import (POISON_KINDS, SCENARIOS,
+                                         SCORED_FAMILIES, family_truth,
+                                         generate_traffic)
+from proovread_tpu.testing.faults import (FLEET_KINDS, FaultPlan,
+                                          InjectedDispatchTimeout,
+                                          InjectedFleetFault,
+                                          InjectedReplicaDeath,
+                                          InjectedStalledDrain)
+
+pytestmark = pytest.mark.faults
+
+
+# --------------------------------------------------------------------------
+# unit: replica-scoped fault grammar
+# --------------------------------------------------------------------------
+
+class TestFleetFaultGrammar:
+    def test_parse_addresses_replica_and_ordinal(self):
+        plan = FaultPlan.from_spec("replica_death@r1.j10")
+        (r,) = plan.rules
+        assert (r.kind, r.replica, r.jord) == ("replica_death", 1, 10)
+        assert r.matches_fleet(1, 10, "replica_death")
+        assert not r.matches_fleet(0, 10, "replica_death")
+        assert not r.matches_fleet(1, 9, "replica_death")
+        assert not r.matches_fleet(1, 10, "stalled_drain")
+
+    def test_unordinaled_rule_fires_at_next_probe(self):
+        plan = FaultPlan.from_spec("stalled_drain@r0")
+        assert plan.rules[0].matches_fleet(0, None, "stalled_drain")
+        # an unordinaled probe site is NOT a dispatch site
+        assert not plan.rules[0].matches_fleet(1, None, "stalled_drain")
+
+    def test_wildcard_replica(self):
+        plan = FaultPlan.from_spec("dispatch_timeout@*")
+        assert plan.fires_fleet(0, "dispatch_timeout")
+        assert plan.fires_fleet(3, "dispatch_timeout")
+
+    def test_count_bounds_firings(self):
+        plan = FaultPlan.from_spec("dispatch_timeout@r0x2")
+        assert plan.fires_fleet(0, "dispatch_timeout")
+        assert plan.fires_fleet(0, "dispatch_timeout")
+        assert not plan.fires_fleet(0, "dispatch_timeout")
+
+    def test_check_fleet_raises_typed_attributed_faults(self):
+        for kind, exc in (("replica_death", InjectedReplicaDeath),
+                          ("stalled_drain", InjectedStalledDrain),
+                          ("dispatch_timeout", InjectedDispatchTimeout)):
+            plan = FaultPlan.from_spec(f"{kind}@r2")
+            with pytest.raises(exc) as ei:
+                plan.check_fleet(2, kind)
+            assert isinstance(ei.value, InjectedFleetFault)
+            assert ei.value.replica == 2
+            assert ei.value.kind == kind
+
+    def test_site_misaddressing_rejected(self):
+        for bad in ("replica_death@b0", "replica_death@j3",
+                    "replica_death@d1", "replica_death@r0.p2",
+                    "compile_error@r0", "worker@r1"):
+            with pytest.raises(ValueError):
+                FaultPlan.from_spec(bad)
+
+    def test_every_fleet_kind_parses(self):
+        for kind in FLEET_KINDS:
+            assert FaultPlan.from_spec(f"{kind}@r0").active
+
+
+# --------------------------------------------------------------------------
+# unit: seeded traffic generator
+# --------------------------------------------------------------------------
+
+class TestLoadGen:
+    def test_deterministic_same_seed(self):
+        _, a = generate_traffic(SCENARIOS["slam"])
+        _, b = generate_traffic(SCENARIOS["slam"])
+        assert [j.job_id for j in a] == [j.job_id for j in b]
+        assert [j.arrival_s for j in a] == [j.arrival_s for j in b]
+        assert (json.dumps([j.wire for j in a], sort_keys=True)
+                == json.dumps([j.wire for j in b], sort_keys=True))
+
+    def test_poison_jobs_carry_expected_reasons(self):
+        _, jobs = generate_traffic(SCENARIOS["slam"])
+        poison = [j for j in jobs if j.family == "poison"]
+        assert len(poison) >= len(POISON_KINDS)
+        assert all(j.expect_reject for j in poison)
+        assert all(not j.expect_reject for j in jobs
+                   if j.family != "poison")
+
+    def test_scorable_families_carry_truth(self):
+        _, jobs = generate_traffic(SCENARIOS["slam"])
+        fams = {j.family for j in jobs}
+        assert {"clr", "ont", "ccs"} <= fams
+        for j in jobs:
+            if j.family in SCORED_FAMILIES:
+                assert set(j.truth) == {r.id for r in j.records}
+        truth = family_truth(jobs)
+        assert "ccs" not in truth  # collapse renames reads
+        assert "ont" in truth and "clr" in truth
+
+    def test_bursts_and_arrival_monotonic(self):
+        _, jobs = generate_traffic(SCENARIOS["slam"])
+        assert any(j.burst for j in jobs)
+        arr = [j.arrival_s for j in jobs]
+        assert arr == sorted(arr)
+
+
+# --------------------------------------------------------------------------
+# unit: the ONT error mix is what the docstring claims
+# --------------------------------------------------------------------------
+
+def test_ont_error_mix_indel_dominated():
+    """The falsifiable form of the nanopore profile: deletions dominate
+    every other class (hp-compression rides on top of the base rate) and
+    indels together far outweigh substitutions — the opposite of the
+    sub-dominated Illumina regime and distinct from the CLR balance."""
+    genome = random_genome(3000, seed=7)
+    reads, truth = simulate_ont_reads(genome, 4000, mean_len=400,
+                                      min_len=200, seed=7)
+    assert reads and len(reads) == len(truth)
+    from proovread_tpu.ops.encode import encode_ascii
+    tot = {"sub": 0, "ins": 0, "del": 0}
+    for rec, src in zip(reads, truth):
+        cls = edit_alignment(encode_ascii(rec.seq), src)
+        for k in tot:
+            tot[k] += cls[k]
+    assert tot["del"] > tot["ins"] > 0
+    assert tot["del"] > tot["sub"]
+    assert tot["ins"] + tot["del"] > 2 * tot["sub"]
+
+
+# --------------------------------------------------------------------------
+# unit: LOAD row schema + accounting identities
+# --------------------------------------------------------------------------
+
+def _load_row(**over):
+    """A minimal internally-consistent 2-replica LOAD row: one death,
+    two handoffs, every identity holding."""
+    row = {
+        "load_schema": 1, "scenario": "slam", "n_replicas": 2,
+        "backend": "cpu", "wall_s": 10.0, "bases_per_sec_fleet": 500.0,
+        "jobs": {"routed": 8, "rejected": 3, "rejected_fleet": 0,
+                 "handoffs": 2, "orphaned": 0, "accepted": 10,
+                 "completed": 8, "failed": 0, "cancelled": 0,
+                 "expired": 0, "journaled": 2},
+        "rejections": {"bad-request": 2, "parse-error": 1},
+        "latency": {
+            "512": {"count": 5, "p50_s": 1.0, "p99_s": 2.0,
+                    "max_s": 2.5},
+            "1024": {"count": 3, "p50_s": 2.0, "p99_s": 4.0,
+                     "max_s": 4.5}},
+        "queue": {"depth_peak": 3, "depth_final": 0},
+        "demotions": {},
+        "accuracy": {"clr": {"n_scored": 10, "identity_before": 0.85,
+                             "identity_after": 0.97,
+                             "identity_after_min": 0.90}},
+        "handoff": {"deaths": 1, "handoffs": 2, "orphaned": 0},
+        "heartbeat": {"samples": 50, "replicas_seen": ["r0", "r1"]},
+        "compile": {"n_programs": 4, "backend_compiles": 4,
+                    "tracing_hit_rate": 0.9},
+        "replicas": [
+            {"replica_id": "r0", "alive": True, "dead_reason": "",
+             "drain_clean": True,
+             "jobs": {"accepted": 6, "rejected": 2, "journaled": 0,
+                      "completed": 6, "failed": 0, "cancelled": 0,
+                      "expired": 0}},
+            {"replica_id": "r1", "alive": False,
+             "dead_reason": "injected", "drain_clean": False,
+             "jobs": {"accepted": 4, "rejected": 1, "journaled": 2,
+                      "completed": 2, "failed": 0, "cancelled": 0,
+                      "expired": 0}}],
+    }
+    row = copy.deepcopy(row)
+    row.update(over)
+    return row
+
+
+class TestValidateLoad:
+    def test_valid_row_with_handoff_passes(self):
+        out = validate_load(_load_row())
+        assert out["jobs"]["accepted"] == 10
+        assert out["deaths"] == 1
+        assert out["families"] == ["clr"]
+
+    def test_field_drift_guard_is_two_sided(self):
+        extra = _load_row()
+        extra["surprise"] = 1
+        with pytest.raises(ValidationError, match="undeclared"):
+            validate_load(extra)
+        for field in LOAD_ROW_FIELDS:
+            broken = _load_row()
+            del broken[field]
+            with pytest.raises(ValidationError):
+                validate_load(broken)
+
+    def test_double_counted_handoff_trips_identity_b(self):
+        # a handoff booked as a second routed job would inflate the
+        # replica-summed accepted above routed + handoffs
+        row = _load_row()
+        row["replicas"][0]["jobs"]["accepted"] += 1
+        row["replicas"][0]["jobs"]["completed"] += 1
+        with pytest.raises(ValidationError):
+            validate_load(row)
+
+    def test_dropped_job_trips_identity_a(self):
+        # a job that vanished from a replica's table: accepted stays,
+        # nothing terminal or journaled accounts for it
+        row = _load_row()
+        row["replicas"][1]["jobs"]["journaled"] -= 1
+        with pytest.raises(ValidationError,
+                           match="per-replica identity"):
+            validate_load(row)
+
+    def test_unattributed_journal_entry_trips_identity_c(self):
+        row = _load_row()
+        row["jobs"]["handoffs"] = 1
+        row["handoff"]["handoffs"] = 1
+        with pytest.raises(ValidationError):
+            validate_load(row)
+
+    def test_rejection_vocab_closed_and_summed(self):
+        row = _load_row()
+        row["rejections"]["because-reasons"] = 1
+        with pytest.raises(ValidationError, match="reason"):
+            validate_load(row)
+        row = _load_row()
+        row["rejections"]["bad-request"] += 1
+        with pytest.raises(ValidationError):
+            validate_load(row)
+
+    def test_fleet_level_rejections_reconcile(self):
+        # a dispatcher rejection that never reached a replica (fleet-
+        # level duplicate detection) must be attributed via
+        # rejected_fleet — unattributed, it reads as a lost rejection
+        row = _load_row()
+        row["jobs"]["rejected"] += 1
+        row["rejections"]["duplicate-job"] = 1
+        with pytest.raises(ValidationError):
+            validate_load(row)
+        row["jobs"]["rejected_fleet"] = 1
+        validate_load(row)
+        row["jobs"]["rejected_fleet"] = 99  # more than rejected
+        with pytest.raises(ValidationError):
+            validate_load(row)
+
+    def test_latency_reconciles_with_completed(self):
+        row = _load_row()
+        row["latency"]["512"]["count"] -= 1
+        with pytest.raises(ValidationError):
+            validate_load(row)
+        row = _load_row()
+        row["latency"]["512"]["p50_s"] = 3.0  # p50 > p99
+        with pytest.raises(ValidationError):
+            validate_load(row)
+
+    def test_heartbeat_must_cover_known_replicas_only(self):
+        row = _load_row()
+        row["heartbeat"]["replicas_seen"] = ["r0", "r7"]
+        with pytest.raises(ValidationError):
+            validate_load(row)
+
+
+# --------------------------------------------------------------------------
+# unit: the load-check gate is falsifiable
+# --------------------------------------------------------------------------
+
+def _entries(rows):
+    return [{"source": f"s{i}", "row": r} for i, r in enumerate(rows)]
+
+
+def _regressed(verdict):
+    return sorted(c["check"] for c in verdict["checks"]
+                  if c["status"] == "regressed")
+
+
+class TestLoadGate:
+    def test_clean_history_passes(self):
+        v = load_check(_entries([_load_row(), _load_row()]))
+        assert v["verdict"] == "PASS" and not _regressed(v)
+
+    def test_injected_p99_regression_trips(self):
+        bad = _load_row()
+        bad["latency"]["512"] = {"count": 5, "p50_s": 3.0,
+                                 "p99_s": 6.5, "max_s": 7.0}
+        v = load_check(_entries([_load_row(), bad]))
+        assert v["verdict"] == "REGRESSION"
+        assert "slam/x2/cpu:p99:512" in _regressed(v)
+
+    def test_injected_throughput_collapse_trips(self):
+        v = load_check(_entries(
+            [_load_row(), _load_row(bases_per_sec_fleet=100.0)]))
+        assert "slam/x2/cpu:bases_per_sec_fleet" in _regressed(v)
+
+    def test_broken_identity_in_newest_row_trips(self):
+        bad = _load_row()
+        bad["jobs"]["completed"] -= 1
+        v = load_check(_entries([_load_row(), bad]))
+        assert "slam/x2/cpu:identity" in _regressed(v)
+
+    def test_orphaned_job_trips_even_with_identities_intact(self):
+        bad = _load_row()
+        bad["jobs"].update(orphaned=1, handoffs=1, routed=9)
+        bad["handoff"].update(orphaned=1, handoffs=1)
+        v = load_check(_entries([_load_row(), bad]))
+        assert "slam/x2/cpu:orphaned" in _regressed(v)
+
+    def test_accuracy_drop_and_uplift_inversion_trip(self):
+        bad = _load_row()
+        bad["accuracy"]["clr"]["identity_after"] = 0.94
+        v = load_check(_entries([_load_row(), bad]))
+        assert "slam/x2/cpu:identity:clr" in _regressed(v)
+        inv = _load_row()
+        inv["accuracy"]["clr"].update(identity_before=0.98,
+                                      identity_after=0.90)
+        v = load_check(_entries([inv]))  # absolute — no baseline needed
+        assert "slam/x2/cpu:uplift:clr" in _regressed(v)
+
+    def test_pools_do_not_cross_fleet_shapes(self):
+        # a 4-replica row must not become the 2-replica baseline
+        four = _load_row(n_replicas=4, bases_per_sec_fleet=2000.0)
+        four["replicas"] = four["replicas"] + [
+            copy.deepcopy(four["replicas"][0]) for _ in range(2)]
+        for i, r in enumerate(four["replicas"]):
+            r["replica_id"] = f"r{i}"
+        four["replicas"][2]["jobs"] = dict.fromkeys(
+            four["replicas"][2]["jobs"], 0)
+        four["replicas"][3]["jobs"] = dict.fromkeys(
+            four["replicas"][3]["jobs"], 0)
+        four["jobs"].update(accepted=16, completed=14)  # inconsistent,
+        # but this pool's latest row failing validation must not poison
+        # the 2-replica pool's verdict
+        v = load_check(_entries([four, _load_row(), _load_row()]))
+        assert "slam/x2/cpu:bases_per_sec_fleet" not in _regressed(v)
+
+    def test_cli_check_rc1_and_regression_lines(self, tmp_path, capsys):
+        from proovread_tpu.obs import load as load_mod
+        good = tmp_path / "LOAD_r1.json"
+        good.write_text(json.dumps(_load_row()) + "\n")
+        bad_row = _load_row(bases_per_sec_fleet=100.0)
+        bad = tmp_path / "LOAD_r2.json"
+        bad.write_text(json.dumps(bad_row) + "\n")
+        assert load_mod.main(["check", str(good)]) == 0
+        capsys.readouterr()
+        assert load_mod.main(["check", str(good), str(bad)]) == 1
+        err = capsys.readouterr().err
+        assert "LOAD-REGRESSION:" in err
+
+    def test_load_rows_accepts_json_and_jsonl(self, tmp_path):
+        one = tmp_path / "one.json"
+        one.write_text(json.dumps(_load_row()))
+        many = tmp_path / "many.json"
+        many.write_text(json.dumps(_load_row()) + "\n"
+                        + json.dumps(_load_row()) + "\n")
+        assert len(load_rows([str(one), str(many)])) == 3
+
+
+# --------------------------------------------------------------------------
+# live fleet drills (no waves — nothing compiles; tier-1 fast)
+# --------------------------------------------------------------------------
+
+def _fleet(tmp_path, **cfg_over):
+    genome = random_genome(400, seed=1)
+    shorts = simulate_short_reads(genome, 5.0, seed=2)
+    cfg = FleetConfig(state_dir=str(tmp_path / "fleet"), n_replicas=2,
+                      heartbeat_s=0.05, suspect_after=2,
+                      stall_timeout_s=0.5)
+    for k, v in cfg_over.items():
+        setattr(cfg, k, v)
+    sb = FleetScoreboard()
+    disp = FleetDispatcher(shorts, cfg, scoreboard=sb)
+    disp.start()
+    return disp, sb
+
+
+class TestFleetDrills:
+    def test_heartbeat_probes_identity_of_every_replica(self, tmp_path):
+        disp, sb = _fleet(tmp_path)
+        try:
+            for _ in range(100):
+                if len(sb.summary()["replicas_seen"]) == 2:
+                    break
+                import time
+                time.sleep(0.05)
+            s = sb.summary()
+            assert s["replicas_seen"] == ["r0", "r1"]
+            last = sb.samples[-1]
+            assert last["uptime_s"] >= 0.0
+            assert last["draining"] is False
+        finally:
+            disp.close()
+
+    def test_single_probe_blip_is_not_a_death(self, tmp_path):
+        import time
+        disp, sb = _fleet(tmp_path,
+                          fault_spec="dispatch_timeout@r0x1")
+        try:
+            time.sleep(0.6)  # many beats; the blip fires exactly once
+            r0 = disp.replicas[0]
+            assert r0.alive and r0.dead_reason == ""
+            assert r0.fail_streak <= 1  # reset by the next good probe
+        finally:
+            disp.close()
+
+    def test_unordinaled_kill_hands_off_empty_journal(self, tmp_path):
+        import time
+        disp, sb = _fleet(tmp_path, fault_spec="replica_death@r1")
+        try:
+            for _ in range(100):
+                if not disp.replicas[1].alive:
+                    break
+                time.sleep(0.05)
+            r1 = disp.replicas[1]
+            assert not r1.alive and "replica_death" in r1.dead_reason
+            assert r1.final_slo is not None  # SLO preserved at death
+            assert disp.orphaned == 0 and disp.handoffs == 0
+            assert disp.replicas[0].alive  # survivor untouched
+        finally:
+            disp.close()
+
+    def test_fleet_level_duplicate_rejected_before_routing(self,
+                                                           tmp_path):
+        # each replica only knows its own job table — the dispatcher's
+        # books are the fleet-wide one, so a duplicate must bounce
+        # deterministically at dispatch, whatever replica it would have
+        # landed on
+        disp, sb = _fleet(tmp_path)
+        try:
+            disp.books["dup-1"] = {"job_id": "dup-1", "status":
+                                   "accepted"}
+            resp = disp.dispatch(
+                {"op": "submit", "job_id": "dup-1", "tenant": "t0",
+                 "mode": "clr", "reads": []},
+                family="poison", expect_reject="duplicate-job")
+            assert resp["ok"] is False
+            assert resp["reason"] == "duplicate-job"
+            rej = disp.rejections[-1]
+            assert rej["job_id"] == "dup-1" and rej["expected"]
+        finally:
+            disp.close()
+
+    def test_stalled_drain_escalates_to_kill(self, tmp_path):
+        disp, sb = _fleet(tmp_path, fault_spec="stalled_drain@r0")
+        disp.drain_all()
+        try:
+            r0, r1 = disp.replicas
+            assert not r0.alive and not r0.drain_clean
+            assert "stalled" in r0.dead_reason
+            assert r1.drain_clean and r1.dead_reason == "drained"
+            assert disp.orphaned == 0
+        finally:
+            disp.close()
+
+
+# --------------------------------------------------------------------------
+# heavy: real waves through a real 2-replica fleet (nightly tier)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_e2e_slam_with_midwave_kill(tmp_path):
+    """The full load drill as a test: slam traffic (all families +
+    poison) through 2 replicas, replica 1 killed at dispatch ordinal 10,
+    every identity pinned by validate_load, zero jobs lost, per-family
+    accuracy uplift over the fleet path."""
+    from proovread_tpu.obs.load import run_fleet_scenario
+    from proovread_tpu.pipeline.driver import PipelineConfig
+    from proovread_tpu.pipeline.trim import TrimParams
+
+    pcfg = PipelineConfig(engine="scan", n_iterations=1, sampling=False,
+                          batch_reads=8, host_chunk_rows=512,
+                          trim=TrimParams(min_length=150))
+    r = run_fleet_scenario(SCENARIOS["slam"], n_replicas=2,
+                           state_dir=str(tmp_path / "fleet"),
+                           fault_spec="replica_death@r1.j10",
+                           pipeline_config=pcfg, time_scale=0.0)
+    row = r["row"]  # build_row already ran validate_load
+    assert row["handoff"]["deaths"] == 1
+    assert row["jobs"]["handoffs"] >= 1
+    assert row["jobs"]["orphaned"] == 0
+    assert row["jobs"]["failed"] == 0
+    for fam, acc in row["accuracy"].items():
+        assert acc["identity_after"] > acc["identity_before"], fam
+    assert row["heartbeat"]["replicas_seen"] == ["r0", "r1"]
