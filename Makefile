@@ -10,7 +10,13 @@
 #   make perf-report  PERF.md-style phase/kernel tables from that history
 #   make prewarm      populate the persistent compile cache (cold+warm runs)
 #                     and record a COMPILE_*.json census row per config
+#                     (FROM_ARTIFACT=DIR: warm-only, from a factory artifact)
 #   make compile-check  cold-start regression gate over COMPILE_*.json
+#   make factory      AOT-compile the predicted program zoo into ONE
+#                     shippable artifact (cache dir + manifest.json)
+#   make boot-check   warm-boot gate over the BOOT_*.json history
+#   make test-cache-warm  warm .jax_cache_cpu so tier-1 runs inside its
+#                     budget on a cold container (artifact or mini-factory)
 #   make accuracy-record  score truth-sidecar CLI runs (config-3 slice,
 #                     config 4, the 4-way dmesh workload) into ACCURACY rows
 #   make accuracy-check   identity floor + no-regression gate over ACCURACY_*.json
@@ -19,7 +25,7 @@
 #   make load-check   fleet SLO regression gate over the LOAD_*.json history
 #   make bench        the benchmark itself (one JSON row on stdout)
 
-.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke load-smoke load-check perf-check perf-report prewarm compile-check accuracy-record accuracy-check static-check bench
+.PHONY: smoke test test-all test-faults trace-smoke qc-smoke serve-smoke dmesh-smoke load-smoke load-check perf-check perf-report prewarm compile-check factory boot-check test-cache-warm accuracy-record accuracy-check static-check bench
 
 # smoke tier: logic + golden-parity tests, no interpret-mode Pallas
 # kernels — the edit loop (< 2 min on a single core)
@@ -125,9 +131,58 @@ perf-check:
 CONFIGS ?= 4
 COMPILE_OUT ?= COMPILE_prewarm.json
 prewarm:
+ifdef FROM_ARTIFACT
+	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.census prewarm \
+		--configs $(CONFIGS) --from-artifact $(FROM_ARTIFACT) \
+		--out $(COMPILE_OUT)
+else
 	JAX_PLATFORMS=cpu python -m proovread_tpu.obs.census prewarm \
 		--configs $(CONFIGS) --fresh --cache-dir .jax_cache_prewarm \
 		--out $(COMPILE_OUT)
+endif
+
+# AOT zoo factory (docs/OBSERVABILITY.md "Boot scoreboard"): walk the
+# predicted census per config PLUS the mini registry walk (tier-1's
+# shapes, incl. the dmesh chokepoint) through the production jit
+# wrappers, compile everything into $(ARTIFACT)/cache, and write the
+# strict-schema manifest.json LAST. The device topology is pinned to the
+# tier-1 suite's 8 virtual CPU devices — topology is part of the XLA
+# cache key, so the artifact only warms processes booted at the same
+# count (obs/boot.py pins it from the manifest's n_devices).
+# Usage: make factory [ARTIFACT=artifact] [FACTORY_CONFIGS=4,3]
+ARTIFACT ?= artifact
+FACTORY_CONFIGS ?= 4,3
+factory:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m proovread_tpu.analysis.factory \
+		--configs $(FACTORY_CONFIGS) --mini \
+		--artifact $(ARTIFACT) --fresh
+
+# warm-boot gate: every (config, backend, mode) pool's newest BOOT row —
+# any itemized observed⊄shipped violation or an artifact hit rate
+# < 0.98 fails on the FIRST row; boot wall gates against the rolling
+# baseline. Record rows with
+#   python -m proovread_tpu.obs.boot run --artifact $(ARTIFACT) --out BOOT_rNN.json
+boot-check:
+	python -m proovread_tpu.obs.boot check
+
+# tier-1 cache warmer (the PR 18 fresh-container exit-124 fix): populate
+# .jax_cache_cpu so the 870 s tier-1 budget spends on tests, not cold
+# compiles. Uses the shipped artifact when present (seconds — pure file
+# copies), else runs the mini factory walk directly into the cache
+# (minutes). Same pinned topology as tests/conftest.py.
+test-cache-warm:
+	@if [ -f $(ARTIFACT)/manifest.json ]; then \
+		python -m proovread_tpu.obs.boot warm-tier1 \
+			--artifact $(ARTIFACT) --dest .jax_cache_cpu; \
+	else \
+		echo "test-cache-warm: no $(ARTIFACT)/manifest.json — running the mini factory walk (slower)"; \
+		JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+			python -m proovread_tpu.analysis.factory \
+			--configs '' --mini --cache-dir .jax_cache_cpu; \
+	fi
 
 # cold-start regression gate: every (config, backend) pool's newest
 # COMPILE_*.json row vs its rolling baseline — warm compile seconds,

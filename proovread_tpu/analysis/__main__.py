@@ -7,6 +7,8 @@
     python -m proovread_tpu.analysis predict --config 4 [--out FILE]
     python -m proovread_tpu.analysis baseline        # accept current debts
     python -m proovread_tpu.analysis budget          # accept current zoo
+    python -m proovread_tpu.analysis factory ...     # AOT compile farm
+                                         (delegates to analysis/factory.py)
 
 ``check`` runs, in order:
 
@@ -229,6 +231,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     bd.add_argument("--budget", default=None)
     bd.set_defaults(fn=cmd_budget)
 
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "factory":
+        # the compile farm owns its own argv contract (and initializes
+        # jax — keep it out of this parser's import path)
+        from proovread_tpu.analysis.factory import main as factory_main
+        return factory_main(argv[1:])
     args = ap.parse_args(argv)
     return args.fn(args)
 

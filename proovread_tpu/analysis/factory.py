@@ -1,0 +1,414 @@
+"""AOT zoo factory: compile the predicted census into a shippable artifact.
+
+``make prewarm`` populates a persistent cache by *running the workload
+twice* — >14 minutes of cold compiles per serving config, paid again by
+every fresh replica. This module closes ROADMAP item 2's loop: the
+census predictor (``analysis/predict.py``) already enumerates every
+(entry, signature) program a config compiles, and every registered entry
+point forwards ``.lower`` from its underlying jit object
+(``obs/profile.py:attributed``) — so the whole zoo can be AOT-lowered
+and compiled at abstract shapes, *without executing a single wave*, into
+ONE versioned artifact::
+
+    <artifact>/cache/          the populated persistent compile cache
+    <artifact>/manifest.json   one strict-schema row per program
+
+The manifest row (declared in ``obs/validate.py:MANIFEST_ROW_FIELDS``,
+two-sided drift guard in tests/test_boot.py) carries: entry, the
+``obs/compilecache.py:signature`` hash (bit-equal to the ledger row a
+real call at those shapes records), backend, compile ms, the persistent
+cache key (file) the compile landed, and its artifact bytes. The meta
+line carries the full cache-dir file inventory (name -> bytes), so
+``obs/boot.py:verify_artifact`` can prove an artifact intact before a
+replica trusts it — ship the artifact, not the work.
+
+Three walks share one farm:
+
+- ``--configs 4,3``: the census walk — every program ``predict.RECIPES``
+  enumerates at the real bucket-table shapes, compiled through the
+  registry's production wrappers (``analysis/entrypoints.py``).
+- ``--mini`` (with ``--configs ''``): the registry walk at the miniature
+  tier-1 geometry (``entrypoints.G``), INCLUDING the
+  ``compile_step_with_plan`` chokepoint (``dmesh:step`` through a
+  1-device mesh) — the programs the test suite compiles, so
+  ``make test-cache-warm`` can boot a cold container's ``.jax_cache_cpu``
+  from the artifact instead of timing out tier-1 (the PR 18 exit 124).
+- ``--cache-dir D --report-out F``: farm into an EXISTING cache dir and
+  write the full report (manifest rows + ledger rows) — the boot child
+  ``obs/boot.py run`` measures and reconciles (observed ⊆ shipped).
+
+``dmesh:*`` signatures are salted per-process in real ledgers
+(``compile_step_with_plan``); the manifest records the UNSALTED argument
+hash, which the boot walk recomputes identically — cross-process
+equality holds for the factory/boot pair, while reconciliation against
+a real run's ledger stays count-level for ``:`` entries
+(``predict.reconcile``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+MANIFEST_SCHEMA = 1
+
+# artifact layout (ONE versioned directory, ship it whole)
+MANIFEST_NAME = "manifest.json"
+CACHE_SUBDIR = "cache"
+
+
+def _log(msg: str) -> None:
+    print(f"[factory] {msg}", file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------------------
+# program enumeration (the walks)
+# --------------------------------------------------------------------------
+
+class WorkItem:
+    """One program to compile: the production wrapper getter plus the
+    exact abstract call the census predicted (sig is the ledger hash)."""
+
+    __slots__ = ("entry", "config", "sig", "args", "kw", "get_fn")
+
+    def __init__(self, entry: str, config: str, sig: str, args: tuple,
+                 kw: dict, get_fn: Callable[[], Any]):
+        self.entry = entry
+        self.config = config
+        self.sig = sig
+        self.args = args
+        self.kw = kw
+        self.get_fn = get_fn
+
+
+def _registry_by_name() -> Dict[str, Any]:
+    from proovread_tpu.analysis.entrypoints import registry
+    return {spec.name: spec for spec in registry()}
+
+
+def census_items(config: int, cap_bases: Optional[int] = None,
+                 interpret: Optional[bool] = None) -> List[WorkItem]:
+    """The census walk: every (entry, args, kw) ``predict.RECIPES``
+    yields for this config, deduped by (entry, sig) — the same dedup the
+    jit tracing cache performs, so the item list length equals
+    ``predict_config(...)['n_programs']``."""
+    from proovread_tpu.analysis import predict
+    from proovread_tpu.analysis.shapes import build_plan
+    from proovread_tpu.obs import compilecache
+    if interpret is None:
+        interpret = predict.interpret_for_backend(_backend())
+    plan = build_plan(config, cap_bases)
+    specs = _registry_by_name()
+    items: List[WorkItem] = []
+    seen: set = set()
+    for b in plan.buckets:
+        for recipe in predict.RECIPES:
+            for entry, args, kw in recipe(plan, b, interpret):
+                sig = compilecache.signature(args, kw)
+                if (entry, sig) in seen:
+                    continue
+                seen.add((entry, sig))
+                items.append(WorkItem(entry, f"config{config}", sig,
+                                      args, kw, specs[entry].fn))
+    return items
+
+
+def mini_items(entries: Optional[List[str]] = None) -> List[WorkItem]:
+    """The registry walk at the miniature tier-1 geometry
+    (``entrypoints.G``): every registered entry point — including the
+    ``dmesh:step`` chokepoint through a 1-device mesh — at the shapes
+    the test suite compiles."""
+    from proovread_tpu.obs import compilecache
+    items: List[WorkItem] = []
+    for name, spec in _registry_by_name().items():
+        if entries is not None and name not in entries:
+            continue
+        args, kw = spec.build_args()
+        items.append(WorkItem(name, "mini",
+                              compilecache.signature(args, kw),
+                              args, kw, spec.fn))
+    return items
+
+
+def _backend() -> str:
+    env = (os.environ.get("JAX_PLATFORMS") or "").split(",")[0].strip()
+    if env:
+        return env
+    import jax
+    return jax.default_backend()
+
+
+# --------------------------------------------------------------------------
+# the farm
+# --------------------------------------------------------------------------
+
+def _cache_files(cache_dir: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    if not os.path.isdir(cache_dir):
+        return out
+    for root, _dirs, files in os.walk(cache_dir):
+        for f in files:
+            p = os.path.join(root, f)
+            out[os.path.relpath(p, cache_dir)] = os.path.getsize(p)
+    return out
+
+
+def compile_farm(items: List[WorkItem], cache_dir: str,
+                 ledger=None) -> Dict[str, Any]:
+    """AOT-lower and compile every item through its production wrapper
+    against ``cache_dir``. Each compile runs under a manually opened
+    ledger call window (``call_begin``/``call_end`` with the item's
+    manifest signature — ``.lower`` bypasses the ``attributed`` call
+    path), so backend-compile events and persistent hit/miss attribute
+    to the program exactly as a real first call would.
+
+    Returns ``{"programs": [row...], "census": ..., "rows": [...],
+    "wall_s", "by_config"}`` — rows are the full ledger event list (the
+    boot reconciler itemizes misses from them)."""
+    from proovread_tpu.obs import compilecache
+    t_start = time.monotonic()
+    own_ledger = ledger is None
+    if own_ledger:
+        ledger = compilecache.Ledger()
+    rows: List[Dict[str, Any]] = []
+    by_config: Dict[str, Dict[str, Any]] = {}
+    done = set()
+    with compilecache.scope(ledger if own_ledger else None) as led:
+        for i, it in enumerate(items):
+            if (it.entry, it.sig) in done:
+                # configs legitimately predict overlapping programs
+                # (same shape reached from two ladders); the artifact
+                # ships ONE row per distinct program, first config wins
+                _log(f"[{i + 1}/{len(items)}] {it.config} {it.entry} "
+                     f"sig={it.sig} already compiled — shared program")
+                continue
+            done.add((it.entry, it.sig))
+            fn = it.get_fn()
+            if not hasattr(fn, "lower"):
+                raise RuntimeError(
+                    f"{it.entry}: wrapper does not forward .lower — the "
+                    "factory needs the attributed jit object")
+            before = set(_cache_files(cache_dir))
+            c0 = led.backend_compile_s
+            n0 = led.backend_compiles
+            h0, m0 = led.persistent_hits, led.persistent_misses
+            t0 = time.monotonic()
+            tok = led.call_begin(it.entry, it.sig)
+            try:
+                fn.lower(*it.args, **it.kw).compile()
+            finally:
+                led.call_end(tok)
+            wall_ms = (time.monotonic() - t0) * 1e3
+            after = _cache_files(cache_dir)
+            new = sorted(set(after) - before)
+            hits = led.persistent_hits - h0
+            misses = led.persistent_misses - m0
+            row = {
+                "entry": it.entry, "sig": it.sig, "config": it.config,
+                "backend": led.backend(),
+                "compile_ms": round((led.backend_compile_s - c0) * 1e3,
+                                    3),
+                "persistent": (None if not (hits or misses)
+                               else "miss" if misses else "hit"),
+                "cache_key": new[0] if new else None,
+                "artifact_bytes": sum(after[f] for f in new),
+            }
+            rows.append(row)
+            bc = by_config.setdefault(
+                it.config, {"n_programs": 0, "compile_s": 0.0,
+                            "backend_compiles": 0, "wall_s": 0.0})
+            bc["n_programs"] += 1
+            bc["compile_s"] = round(
+                bc["compile_s"] + row["compile_ms"] / 1e3, 3)
+            bc["backend_compiles"] += led.backend_compiles - n0
+            bc["wall_s"] = round(bc["wall_s"] + wall_ms / 1e3, 3)
+            _log(f"[{i + 1}/{len(items)}] {it.config} {it.entry} "
+                 f"sig={it.sig} compile={row['compile_ms']:.0f}ms "
+                 f"cache={row['persistent'] or 'off'}")
+        census = led.census()
+        event_rows = list(led.rows)
+    return {"programs": rows, "census": census, "rows": event_rows,
+            "wall_s": round(time.monotonic() - t_start, 3),
+            "by_config": by_config}
+
+
+def manifest_version(programs: List[Dict[str, Any]], backend: str) -> str:
+    """Deterministic content hash of the shipped program set — the
+    artifact's version string (same programs => same version)."""
+    import jax
+    key = json.dumps(
+        [sorted((p["entry"], p["sig"], p["config"]) for p in programs),
+         backend, jax.__version__], sort_keys=True)
+    return hashlib.blake2b(key.encode(), digest_size=8).hexdigest()
+
+
+def build_manifest(result: Dict[str, Any], cache_dir: str,
+                   configs: List[str], interpret: bool) -> Dict[str, Any]:
+    import jax
+    backend = result["census"]["backend"]
+    return {
+        "manifest_schema": MANIFEST_SCHEMA,
+        "version": manifest_version(result["programs"], backend),
+        "backend": backend,
+        "interpret": interpret,
+        "configs": configs,
+        "n_programs": len(result["programs"]),
+        "compile_s": result["census"]["backend_compile_s"],
+        "wall_s": result["wall_s"],
+        "n_devices": jax.device_count(),
+        "jax_version": jax.__version__,
+        "by_config": result["by_config"],
+        "files": _cache_files(cache_dir),
+        "programs": result["programs"],
+    }
+
+
+def build_artifact(artifact_dir: str, configs: List[int], *,
+                   mini: bool = True,
+                   entries: Optional[List[str]] = None,
+                   cap_bases: Optional[Dict[int, Optional[int]]] = None,
+                   fresh: bool = False) -> Dict[str, Any]:
+    """The full factory: census walk per config (+ the mini registry
+    walk covering tier-1 and the dmesh chokepoint) into
+    ``<artifact>/cache``, manifest written LAST (a torn build has no
+    manifest and fails verification, never ships half a zoo)."""
+    import shutil
+
+    from proovread_tpu.analysis import predict
+    from proovread_tpu.obs import compilecache
+    cache_dir = os.path.join(artifact_dir, CACHE_SUBDIR)
+    if fresh and os.path.isdir(cache_dir):
+        _log(f"wiping {cache_dir} (--fresh)")
+        shutil.rmtree(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    # cache on BEFORE building items: the pipeline imports compile
+    # module-level constants, and those must land in the artifact too
+    # (a boot process pays them otherwise)
+    compilecache.enable_persistent_cache(cache_dir)
+    interpret = predict.interpret_for_backend(_backend())
+    items: List[WorkItem] = []
+    caps = dict(cap_bases or {})
+    for cfg in configs:
+        items.extend(census_items(cfg, caps.get(cfg), interpret))
+    if mini:
+        items.extend(mini_items(entries))
+    _log(f"{len(items)} program(s) to compile "
+         f"(configs={configs}, mini={mini})")
+    result = compile_farm(items, cache_dir)
+    manifest = build_manifest(
+        result, cache_dir,
+        [f"config{c}" for c in configs] + (["mini"] if mini else []),
+        interpret)
+    # a manifest the consumers would reject must fail HERE, not at boot
+    from proovread_tpu.obs.validate import validate_manifest
+    validate_manifest(manifest)
+    path = os.path.join(artifact_dir, MANIFEST_NAME)
+    with open(path, "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    _log(f"artifact {manifest['version']}: {manifest['n_programs']} "
+         f"program(s), {len(manifest['files'])} cache file(s), "
+         f"{sum(manifest['files'].values())} bytes -> {artifact_dir}")
+    return manifest
+
+
+# --------------------------------------------------------------------------
+# CLI (also the boot child: obs/boot.py shells out here per mode)
+# --------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from proovread_tpu.analysis.predict import FACTORY_CONFIGS
+    ap = argparse.ArgumentParser(
+        prog="proovread-tpu-factory",
+        description="AOT zoo factory: compile the predicted census into "
+                    "a shippable cache artifact + manifest "
+                    "(docs/OBSERVABILITY.md 'Boot scoreboard').")
+    ap.add_argument("--configs", default="4,3",
+                    help="comma-separated census configs "
+                         f"(supported: {FACTORY_CONFIGS}; '' = none)")
+    ap.add_argument("--mini", action="store_true",
+                    help="add the registry walk at the miniature tier-1 "
+                         "geometry (incl. the dmesh:step chokepoint)")
+    ap.add_argument("--entries", default=None,
+                    help="restrict the --mini walk to these entry names")
+    ap.add_argument("--cap-bases", default=None,
+                    help="per-config caps, e.g. '3=80000' (default: "
+                         "census.DEFAULT_CAPS)")
+    ap.add_argument("--artifact", default=None, metavar="DIR",
+                    help="build the shippable artifact here "
+                         "(DIR/cache + DIR/manifest.json)")
+    ap.add_argument("--fresh", action="store_true",
+                    help="wipe the artifact cache dir first")
+    ap.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="farm into an existing cache dir instead of "
+                         "building an artifact (test-cache-warm, the "
+                         "boot child)")
+    ap.add_argument("--report-out", default=None, metavar="FILE",
+                    help="with --cache-dir: write the full report "
+                         "(manifest rows + ledger event rows) here")
+    args = ap.parse_args(argv)
+    if (args.artifact is None) == (args.cache_dir is None):
+        ap.error("exactly one of --artifact / --cache-dir is required")
+
+    configs = [int(c) for c in args.configs.split(",") if c]
+    bad = [c for c in configs if c not in FACTORY_CONFIGS]
+    if bad:
+        ap.error(f"unsupported config(s) {bad}: the factory builds the "
+                 f"simulated ladder rungs {FACTORY_CONFIGS} "
+                 "(analysis/predict.py FACTORY_CONFIGS)")
+    from proovread_tpu.obs.census import DEFAULT_CAPS
+    caps: Dict[int, Optional[int]] = dict(DEFAULT_CAPS)
+    if args.cap_bases:
+        for part in args.cap_bases.split(","):
+            k, _, v = part.partition("=")
+            caps[int(k)] = int(v) if v else None
+    entries = (args.entries.split(",") if args.entries else None)
+
+    if args.artifact:
+        build_artifact(args.artifact, configs,
+                       mini=args.mini or not configs, entries=entries,
+                       cap_bases=caps, fresh=args.fresh)
+        return 0
+
+    # --cache-dir mode: farm into the given dir, report everything
+    from proovread_tpu.analysis import predict
+    from proovread_tpu.obs import compilecache
+    os.makedirs(args.cache_dir, exist_ok=True)
+    compilecache.enable_persistent_cache(args.cache_dir)
+    interpret = predict.interpret_for_backend(_backend())
+    items: List[WorkItem] = []
+    for cfg in configs:
+        items.extend(census_items(cfg, caps.get(cfg), interpret))
+    if args.mini or not configs:
+        items.extend(mini_items(entries))
+    _log(f"{len(items)} program(s) into {args.cache_dir}")
+    result = compile_farm(items, args.cache_dir)
+    report = {
+        "manifest_schema": MANIFEST_SCHEMA,
+        "backend": result["census"]["backend"],
+        "interpret": interpret,
+        "wall_s": result["wall_s"],
+        "by_config": result["by_config"],
+        "census": result["census"],
+        "programs": result["programs"],
+        "rows": result["rows"],
+    }
+    if args.report_out:
+        with open(args.report_out, "w") as fh:
+            json.dump(report, fh)
+            fh.write("\n")
+    c = result["census"]
+    _log(f"done: {len(result['programs'])} program(s), "
+         f"{c['backend_compiles']} backend compile(s) / "
+         f"{c['backend_compile_s']:.3f}s, persistent "
+         f"{c['persistent_hits']} hit / {c['persistent_misses']} miss")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,16 @@ PREDICT_SCHEMA = 1
 DEFAULT_BUDGET = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "budget.json")
 
+# The bench configs the shape oracle (analysis/shapes.py:build_plan),
+# the census prewarm (obs/census.py) and the AOT zoo factory
+# (analysis/factory.py) all support: the simulated, self-contained
+# ladder rungs. Configs 1/2 need the F.antasticus reference sample and
+# differ only by iteration schedule. A keep-in-sync lint
+# (tests/test_boot.py) fails loudly when bench.py's config ladder
+# drifts from this set — extend build_plan + the census workloads + the
+# budget when adding a rung here.
+FACTORY_CONFIGS = (3, 4)
+
 
 def _sds(shape, dtype):
     import jax

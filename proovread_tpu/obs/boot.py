@@ -1,0 +1,750 @@
+"""Warm-boot observability: boot as a measured, reconciled, gated event.
+
+``analysis/factory.py`` ships the compile zoo as ONE versioned artifact
+(a populated persistent cache + ``manifest.json``). This module makes a
+fresh process's boot against that artifact *observable*:
+
+- ``verify_artifact`` proves an artifact intact before anything trusts
+  it: the manifest validates strictly (``obs/validate.py:
+  validate_manifest``) and every cache file in its inventory exists with
+  the exact recorded byte size — a tampered or torn artifact fails
+  loudly, it never half-warms a replica.
+- ``fetch_artifact`` is the replica "download" step (``serve/fleet.py``):
+  verify at the source, copy the cache next to the replica state, verify
+  again at the destination.
+- ``boot run`` measures: per config and per mode (``cold`` = empty cache
+  dir, ``artifact`` = a fresh copy of the shipped cache), a SUBPROCESS
+  factory walk re-compiles the census — fresh process on purpose, the
+  in-process jit memo would fake a warm boot — and one strict-schema
+  BOOT row per (config, mode) records boot wall, backend compiles,
+  persistent hits/misses and hit rate.
+- ``reconcile`` proves **observed ⊆ shipped**: every backend compile at
+  boot that is not a persistent-cache hit is an itemized
+  ``compiled-at-boot`` violation, every compiled program absent from the
+  manifest an ``unmanifested`` one — rc 1 on any. (Against a real run's
+  LEDGER artifact the ``dmesh:*`` per-process signature salt is stripped
+  before the manifest lookup.)
+- ``check`` is the gate (``make boot-check``): rows pool per (config,
+  backend, mode) like every other scoreboard; absolute checks — any
+  violation, artifact hit rate < ``MIN_ARTIFACT_HIT_RATE`` — fire on
+  the FIRST row, boot wall gates against a rolling-median baseline.
+  Exit 1 + ``BOOT-REGRESSION:`` lines on any breach.
+- ``warm-tier1`` copies the artifact's cache files into ``.jax_cache_cpu``
+  (``make test-cache-warm``) so a cold container runs tier-1 inside its
+  budget instead of timing out on cold compiles (the PR 18 exit 124).
+
+The parent never initializes jax (TPU ownership is process-exclusive —
+the same discipline as ``obs/census.py:prewarm_config``); boot walls are
+measured around whole subprocesses, which is what a replica actually
+pays. ``BootSpan`` is the in-process variant the fleet wraps around each
+replica start (docs/SERVING.md "Fleet warm boot").
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from proovread_tpu.obs.regress import _median
+
+SCHEMA_VERSION = 1
+
+# absolute floor for an artifact-mode boot's persistent-cache hit rate:
+# below this the artifact did not do its one job. Fires on the FIRST
+# row (no baseline needed); skipped only when the boot compiled nothing
+# at all (0 backend compiles is a perfect warm boot, not a missing rate)
+MIN_ARTIFACT_HIT_RATE = 0.98
+# boot wall may grow by this fraction of the rolling-median baseline ...
+BOOT_WALL_THRESHOLD = 0.50
+# ... but only when the absolute growth also exceeds this (CPU boot
+# walls are tens of seconds; pure ratios on small baselines cry wolf)
+BOOT_WALL_MIN_ABS_S = 5.0
+# rolling baseline: median over up to this many prior usable rows
+BASELINE_WINDOW = 3
+
+_FACTORY_MOD = "proovread_tpu.analysis.factory"
+
+
+def _log(msg: str) -> None:
+    print(f"[boot] {msg}", file=sys.stderr, flush=True)
+
+
+# -- artifact loading / verification ---------------------------------------
+
+def load_manifest(artifact_dir: str) -> Dict[str, Any]:
+    """Read + strictly validate ``<artifact>/manifest.json``."""
+    from proovread_tpu.analysis.factory import MANIFEST_NAME
+    from proovread_tpu.obs.validate import validate_manifest
+    path = os.path.join(artifact_dir, MANIFEST_NAME)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"{artifact_dir}: no {MANIFEST_NAME} — not a factory "
+            "artifact (run `make factory` first)")
+    with open(path) as fh:
+        manifest = json.load(fh)
+    validate_manifest(manifest, where=path)
+    return manifest
+
+
+def verify_artifact(artifact_dir: str,
+                    manifest: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """Prove the artifact intact: manifest validates, every file in its
+    inventory exists under ``cache/`` with the exact recorded size, and
+    no unmanifested file hides in the cache dir (an extra file means
+    something compiled INTO the artifact after it shipped — the
+    observed ⊆ shipped proof would silently widen). Returns the
+    manifest; raises ``ValidationError``."""
+    from proovread_tpu.analysis.factory import CACHE_SUBDIR, _cache_files
+    from proovread_tpu.obs.validate import ValidationError
+    if manifest is None:
+        manifest = load_manifest(artifact_dir)
+    cache_dir = os.path.join(artifact_dir, CACHE_SUBDIR)
+    have = _cache_files(cache_dir)
+    want = manifest["files"]
+    problems = []
+    for name, size in sorted(want.items()):
+        if name not in have:
+            problems.append(f"missing cache file {name!r} ({size} B)")
+        elif have[name] != size:
+            problems.append(f"cache file {name!r} is {have[name]} B, "
+                            f"manifest says {size} B")
+    for name in sorted(set(have) - set(want)):
+        problems.append(f"unmanifested cache file {name!r} "
+                        f"({have[name]} B)")
+    if problems:
+        raise ValidationError(
+            f"{artifact_dir}: artifact fails verification "
+            f"(version {manifest['version']}): " + "; ".join(problems))
+    return manifest
+
+
+def fetch_artifact(artifact_dir: str, dest_cache_dir: str
+                   ) -> Dict[str, Any]:
+    """The replica 'download' step: verify the artifact at its source,
+    copy its cache to ``dest_cache_dir`` (wiping any stale copy), and
+    verify the copy byte-for-byte against the same manifest. Returns
+    the manifest."""
+    from proovread_tpu.analysis.factory import CACHE_SUBDIR, _cache_files
+    from proovread_tpu.obs.validate import ValidationError
+    manifest = verify_artifact(artifact_dir)
+    src = os.path.join(artifact_dir, CACHE_SUBDIR)
+    if os.path.isdir(dest_cache_dir):
+        shutil.rmtree(dest_cache_dir)
+    shutil.copytree(src, dest_cache_dir)
+    have = _cache_files(dest_cache_dir)
+    if have != manifest["files"]:
+        raise ValidationError(
+            f"{dest_cache_dir}: artifact copy does not match the "
+            f"manifest inventory (version {manifest['version']})")
+    return manifest
+
+
+# -- reconciliation: observed ⊆ shipped ------------------------------------
+
+def _strip_salt(entry: str, sig: str) -> str:
+    """``dmesh:*`` retrace signatures carry a per-process ``vN.`` salt
+    (``parallel/dmesh.py:compile_step_with_plan``); the manifest records
+    the unsalted argument hash."""
+    if ":" in entry and "." in sig:
+        return sig.split(".", 1)[1]
+    return sig
+
+
+def manifest_keys(manifest: Dict[str, Any]) -> set:
+    return {(p["entry"], p["sig"]) for p in manifest["programs"]}
+
+
+def reconcile(manifest: Dict[str, Any], report: Dict[str, Any]
+              ) -> List[Dict[str, Any]]:
+    """Itemize every way a boot report (``factory --report-out``)
+    violates *observed ⊆ shipped* against a manifest:
+
+    - ``compiled-at-boot``: a backend-compile event whose persistent-
+      cache outcome is not ``hit`` (a miss, or cache off) — the boot
+      paid a compile the artifact was supposed to ship;
+    - ``unmanifested``: a compiled program whose (entry, sig) is not a
+      manifest row — boot work the manifest does not even know about.
+
+    Empty list == proof."""
+    shipped = manifest_keys(manifest)
+    violations: List[Dict[str, Any]] = []
+    for row in report.get("rows", ()):
+        if row.get("kind") != "backend_compile":
+            continue
+        if row.get("persistent_cache") != "hit":
+            violations.append({
+                "kind": "compiled-at-boot",
+                "entry": row["entry"], "sig": row["sig"],
+                "detail": f"persistent_cache={row.get('persistent_cache')}"
+                          f" compile_ms={row.get('compile_ms')}"})
+    for prog in report.get("programs", ()):
+        key = (prog["entry"], _strip_salt(prog["entry"], prog["sig"]))
+        if key not in shipped:
+            violations.append({
+                "kind": "unmanifested",
+                "entry": prog["entry"], "sig": prog["sig"],
+                "detail": "compiled program absent from the manifest"})
+    return violations
+
+
+def reconcile_ledger(manifest: Dict[str, Any], ledger_path: str
+                     ) -> List[Dict[str, Any]]:
+    """Reconcile a real run's LEDGER artifact against the manifest:
+    every observed program (retrace row, salt-stripped) that is not a
+    manifest row is ``unmanifested`` — the never-shipped class `make
+    compile-check` cross-links. (The converse — shipped but never
+    observed — is the stale class the caller reports, not a
+    violation.)"""
+    shipped = manifest_keys(manifest)
+    violations: List[Dict[str, Any]] = []
+    seen: set = set()
+    with open(ledger_path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if lineno == 1 or not line:
+                continue                     # meta line
+            row = json.loads(line)
+            if row.get("kind") != "retrace" \
+                    or row.get("entry") == "(unattributed)":
+                continue
+            key = (row["entry"], _strip_salt(row["entry"], row["sig"]))
+            if key not in shipped and key not in seen:
+                seen.add(key)
+                violations.append({
+                    "kind": "unmanifested",
+                    "entry": row["entry"], "sig": row["sig"],
+                    "detail": f"{ledger_path}:{lineno}: observed program "
+                              "absent from the manifest"})
+    return violations
+
+
+def stale_programs(manifest: Dict[str, Any], ledger_path: str
+                   ) -> List[Tuple[str, str]]:
+    """Shipped-but-never-observed (entry, sig) pairs — artifact bytes no
+    real run touches; the stale class `make compile-check` reports next
+    to the never-shipped one."""
+    observed = set()
+    with open(ledger_path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if lineno == 1 or not line:
+                continue
+            row = json.loads(line)
+            if row.get("kind") == "retrace":
+                observed.add((row["entry"],
+                              _strip_salt(row["entry"], row["sig"])))
+    return sorted((p["entry"], p["sig"]) for p in manifest["programs"]
+                  if (p["entry"], p["sig"]) not in observed)
+
+
+# -- the boot span (in-process, fleet replicas) ----------------------------
+
+class BootSpan:
+    """Snapshot a ledger's compile counters around a boot-critical
+    section (the fleet wraps one around each replica start). ``row()``
+    yields a strict-schema BOOT row from the deltas; in artifact mode
+    every non-hit backend compile inside the span becomes an itemized
+    ``compiled-at-boot`` violation."""
+
+    def __init__(self, ledger):
+        self._ledger = ledger
+        self._t0 = time.monotonic()
+        self._compiles = ledger.backend_compiles
+        self._compile_s = ledger.backend_compile_s
+        self._hits = ledger.persistent_hits
+        self._misses = ledger.persistent_misses
+        self._row0 = len(ledger.rows)
+
+    def row(self, *, config: str, mode: str,
+            manifest: Optional[Dict[str, Any]] = None,
+            artifact: Optional[str] = None,
+            replica: Optional[str] = None,
+            n_programs: Optional[int] = None) -> Dict[str, Any]:
+        led = self._ledger
+        hits = led.persistent_hits - self._hits
+        misses = led.persistent_misses - self._misses
+        span_rows = led.rows[self._row0:]
+        violations: List[Dict[str, Any]] = []
+        if mode == "artifact":
+            for r in span_rows:
+                if r.get("kind") == "backend_compile" \
+                        and r.get("persistent_cache") != "hit":
+                    violations.append({
+                        "kind": "compiled-at-boot",
+                        "entry": r["entry"], "sig": r["sig"],
+                        "detail": "persistent_cache="
+                                  f"{r.get('persistent_cache')} "
+                                  f"compile_ms={r.get('compile_ms')}"})
+            if manifest is not None:
+                shipped = manifest_keys(manifest)
+                for r in span_rows:
+                    if r.get("kind") != "retrace" \
+                            or r.get("entry") == "(unattributed)":
+                        continue
+                    key = (r["entry"],
+                           _strip_salt(r["entry"], r["sig"]))
+                    if key not in shipped:
+                        violations.append({
+                            "kind": "unmanifested",
+                            "entry": r["entry"], "sig": r["sig"],
+                            "detail": "traced program absent from the "
+                                      "manifest"})
+        return {
+            "metric": "boot", "schema": SCHEMA_VERSION,
+            "config": config, "backend": led.backend(), "mode": mode,
+            "replica": replica,
+            "boot_wall_s": round(time.monotonic() - self._t0, 3),
+            "compile_s": round(led.backend_compile_s - self._compile_s,
+                               3),
+            "n_backend_compiles": led.backend_compiles - self._compiles,
+            "persistent_hits": hits, "persistent_misses": misses,
+            "hit_rate": (round(hits / (hits + misses), 4)
+                         if hits + misses else None),
+            "n_programs": (n_programs if n_programs is not None
+                           else sum(1 for r in span_rows
+                                    if r.get("kind") == "retrace")),
+            "violations": violations,
+            "manifest_version": (manifest or {}).get("version"),
+            "artifact": artifact,
+        }
+
+
+# -- measured boots (subprocess, `boot run`) -------------------------------
+
+def _factory_cmd(config: str, cache_dir: str, report: str) -> List[str]:
+    cmd = [sys.executable, "-m", _FACTORY_MOD, "--cache-dir", cache_dir,
+           "--report-out", report]
+    if config == "mini":
+        cmd += ["--configs", "", "--mini"]
+    elif config.startswith("mini:"):
+        # entries separated by '+' (',' is the config separator)
+        cmd += ["--configs", "", "--mini", "--entries",
+                config.split(":", 1)[1].replace("+", ",")]
+    else:
+        if config.startswith("config"):
+            config = config[len("config"):]
+        cmd += ["--configs", config]
+    return cmd
+
+
+def pin_topology(env: Dict[str, str],
+                 n_devices: Optional[int]) -> Dict[str, str]:
+    """Force the child's host-platform device count to the manifest's
+    ``n_devices``: topology is part of every XLA cache key, so a boot
+    under a different device count misses the whole shipped cache. An
+    explicit count already in XLA_FLAGS wins (the caller pinned it)."""
+    if not n_devices:
+        return env
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env = dict(env)
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            f"{n_devices}").strip()
+    return env
+
+
+def boot_once(config: str, mode: str, artifact_dir: Optional[str],
+              workdir: str, *, timeout: float = 5400.0,
+              n_devices: Optional[int] = None
+              ) -> Tuple[Dict[str, Any], float]:
+    """One measured boot in a FRESH subprocess (the in-process jit memo
+    would hide recompiles): the factory walks the census for ``config``
+    against either an empty cache dir (``cold``) or a verified fresh
+    copy of the artifact's cache (``artifact``). Returns (report,
+    boot_wall_s) — the wall is the whole subprocess, interpreter + jax
+    import + compile/load, which is what a replica actually pays. Both
+    modes run under the manifest's device topology so the cold row is
+    the artifact row's true counterfactual."""
+    cache_dir = os.path.join(workdir, f"{mode}_cache")
+    if mode == "artifact":
+        if not artifact_dir:
+            raise ValueError("artifact mode needs --artifact")
+        fetch_artifact(artifact_dir, cache_dir)
+    elif os.path.isdir(cache_dir):
+        shutil.rmtree(cache_dir)
+    report_path = os.path.join(workdir, f"report_{mode}.json")
+    cmd = _factory_cmd(config, cache_dir, report_path)
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd,
+                          env=pin_topology(dict(os.environ), n_devices),
+                          cwd=os.getcwd(), timeout=timeout)
+    wall = time.monotonic() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(f"boot subprocess exited {proc.returncode}: "
+                           f"{' '.join(cmd)}")
+    with open(report_path) as fh:
+        return json.load(fh), wall
+
+
+def boot_row(config: str, mode: str, report: Dict[str, Any],
+             wall_s: float, *,
+             manifest: Optional[Dict[str, Any]] = None,
+             artifact: Optional[str] = None) -> Dict[str, Any]:
+    census = report["census"]
+    hits = census["persistent_hits"]
+    misses = census["persistent_misses"]
+    violations = (reconcile(manifest, report)
+                  if mode == "artifact" and manifest is not None else [])
+    return {
+        "metric": "boot", "schema": SCHEMA_VERSION,
+        "config": config if config.startswith(("config", "mini"))
+        else f"config{config}",
+        "backend": census["backend"], "mode": mode, "replica": None,
+        "boot_wall_s": round(wall_s, 3),
+        "compile_s": census["backend_compile_s"],
+        "n_backend_compiles": census["backend_compiles"],
+        "persistent_hits": hits, "persistent_misses": misses,
+        "hit_rate": (round(hits / (hits + misses), 4)
+                     if hits + misses else None),
+        "n_programs": len(report["programs"]),
+        "violations": violations,
+        "manifest_version": (manifest or {}).get("version"),
+        "artifact": artifact,
+    }
+
+
+# -- the gate (`make boot-check`) ------------------------------------------
+
+def load_rows(paths: List[str]) -> List[Dict[str, Any]]:
+    """BOOT history rows, oldest first (JSON or JSON-lines per file —
+    the COMPILE/LOAD history conventions)."""
+    out: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as fh:
+            text = fh.read()
+        objs: List[Any] = []
+        try:
+            obj = json.loads(text)
+            objs = obj if isinstance(obj, list) else [obj]
+        except json.JSONDecodeError:
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    objs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        for obj in objs:
+            if isinstance(obj, dict) and obj.get("metric") == "boot":
+                out.append({"source": path, "row": obj})
+    return out
+
+
+def _pool_key(row: Dict[str, Any]):
+    return (str(row.get("config")), row.get("backend") or "tpu",
+            str(row.get("mode")))
+
+
+def boot_check(entries: List[Dict[str, Any]],
+               min_hit_rate: float = MIN_ARTIFACT_HIT_RATE,
+               wall_threshold: float = BOOT_WALL_THRESHOLD,
+               wall_min_abs_s: float = BOOT_WALL_MIN_ABS_S,
+               window: int = BASELINE_WINDOW) -> Dict[str, Any]:
+    """The gate, as data: every (config, backend, mode) pool's newest
+    row. ABSOLUTE checks fire on the first row ever recorded — an
+    artifact-mode row with any itemized violation, or a persistent hit
+    rate under ``min_hit_rate`` (when it compiled anything at all), is
+    a regression with no baseline required. Boot wall gates against the
+    rolling-median baseline of its pool, both modes (a cold boot
+    getting 50% slower is a real regression too). Verdict PASS /
+    REGRESSION / NO-DATA."""
+    from proovread_tpu.obs.validate import (ValidationError,
+                                            validate_boot_row)
+    checks: List[Dict[str, Any]] = []
+    usable: List[Dict[str, Any]] = []
+    for e in entries:
+        try:
+            validate_boot_row(e["row"], where=e["source"])
+            usable.append(e)
+        except ValidationError as err:
+            checks.append({"check": "row", "status": "missing",
+                           "source": e["source"], "note": str(err)})
+    if not usable:
+        return {"schema": SCHEMA_VERSION, "verdict": "NO-DATA",
+                "pools": [], "checks": checks}
+
+    pools: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in usable:
+        pools.setdefault(_pool_key(e["row"]), []).append(e)
+
+    pool_names = []
+    for key in sorted(pools):
+        group = pools[key]
+        lrow = group[-1]["row"]
+        base = group[:-1][-window:]
+        name = "/".join(key)
+        pool_names.append(name)
+        if key[2] == "artifact":
+            nviol = len(lrow["violations"])
+            checks.append({
+                "check": f"{name}:violations",
+                "status": "regressed" if nviol else "ok",
+                "value": nviol, "baseline": 0, "threshold": 0,
+                "violations": lrow["violations"][:20]})
+            rate = lrow["hit_rate"]
+            if lrow["n_backend_compiles"] == 0:
+                # a boot that compiled nothing is the perfect warm boot
+                checks.append({"check": f"{name}:hit_rate",
+                               "status": "ok", "value": None,
+                               "baseline": min_hit_rate,
+                               "threshold": min_hit_rate,
+                               "note": "0 backend compiles"})
+            else:
+                bad = rate is None or rate < min_hit_rate
+                checks.append({"check": f"{name}:hit_rate",
+                               "status": "regressed" if bad else "ok",
+                               "value": rate, "baseline": min_hit_rate,
+                               "threshold": min_hit_rate})
+        if not base:
+            checks.append({"check": f"{name}:baseline",
+                           "status": "skipped",
+                           "note": "no prior rows in this pool — "
+                                   "nothing to regress against"})
+            continue
+        base_wall = _median([float(e["row"]["boot_wall_s"])
+                             for e in base])
+        new_wall = float(lrow["boot_wall_s"])
+        regressed = (new_wall - base_wall > wall_min_abs_s
+                     and new_wall > base_wall * (1 + wall_threshold))
+        checks.append({"check": f"{name}:boot_wall_s",
+                       "status": "regressed" if regressed else "ok",
+                       "value": round(new_wall, 3),
+                       "baseline": round(base_wall, 3),
+                       "threshold": wall_threshold})
+    verdict = ("REGRESSION" if any(c["status"] == "regressed"
+                                   for c in checks) else "PASS")
+    return {"schema": SCHEMA_VERSION, "verdict": verdict,
+            "pools": pool_names, "checks": checks}
+
+
+def _resolve_paths(args_paths: List[str]) -> List[str]:
+    if args_paths:
+        return args_paths
+    # round-numbered history first, ad-hoc recordings last (the same
+    # ordering rationale as census._resolve_paths: the freshest local
+    # measurement must be the gate's "latest", not its baseline)
+    rounds = sorted(_glob.glob("BOOT_r*.json"))
+    rest = sorted(p for p in _glob.glob("BOOT_*.json")
+                  if p not in rounds)
+    return rounds + rest
+
+
+# -- tier-1 cache warming (`make test-cache-warm`) -------------------------
+
+def warm_cache_dir(artifact_dir: str, dest: str) -> Dict[str, int]:
+    """Copy the verified artifact's cache files into ``dest`` (the
+    tier-1 ``.jax_cache_cpu``), skipping files already present with the
+    right size — idempotent, never clobbers a newer same-named entry
+    with identical bytes semantics (persistent-cache files are
+    content-addressed, same name == same program)."""
+    from proovread_tpu.analysis.factory import CACHE_SUBDIR
+    manifest = verify_artifact(artifact_dir)
+    src = os.path.join(artifact_dir, CACHE_SUBDIR)
+    os.makedirs(dest, exist_ok=True)
+    copied = skipped = 0
+    for name, size in sorted(manifest["files"].items()):
+        dpath = os.path.join(dest, name)
+        if os.path.isfile(dpath) and os.path.getsize(dpath) == size:
+            skipped += 1
+            continue
+        os.makedirs(os.path.dirname(dpath) or dest, exist_ok=True)
+        shutil.copy2(os.path.join(src, name), dpath)
+        copied += 1
+    return {"copied": copied, "skipped": skipped,
+            "total": len(manifest["files"])}
+
+
+# -- CLI -------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from proovread_tpu.obs.validate import (ValidationError,
+                                            validate_boot_row)
+    ap = argparse.ArgumentParser(
+        prog="proovread-tpu-boot",
+        description="Warm-boot observability: measured boots from the "
+                    "factory artifact, observed ⊆ shipped "
+                    "reconciliation, and the boot-check gate "
+                    "(docs/OBSERVABILITY.md 'Boot scoreboard').")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="measure cold vs artifact boots "
+                                     "and record BOOT rows")
+    run.add_argument("--artifact", required=True, metavar="DIR")
+    run.add_argument("--configs", default="4",
+                     help="comma-separated boot configs: census config "
+                          "numbers, 'mini', or 'mini:entry1+entry2'")
+    run.add_argument("--modes", default="cold,artifact",
+                     help="boot modes to measure (default both)")
+    run.add_argument("--out", default=None, metavar="FILE",
+                     help="append rows to this BOOT_*.json (JSON-lines)")
+    run.add_argument("--run-timeout", type=float, default=5400.0)
+
+    rec = sub.add_parser("reconcile",
+                         help="prove observed ⊆ shipped: rc 1 with "
+                              "itemized violations otherwise")
+    rec.add_argument("--artifact", required=True, metavar="DIR")
+    src = rec.add_mutually_exclusive_group(required=True)
+    src.add_argument("--report", metavar="FILE",
+                     help="a factory --report-out boot report")
+    src.add_argument("--ledger", metavar="FILE",
+                     help="a real run's --compile-ledger JSONL")
+
+    chk = sub.add_parser("check", help="gate: exit 1 on regression")
+    chk.add_argument("files", nargs="*",
+                     help="BOOT history files (default: BOOT_*.json)")
+    chk.add_argument("--min-hit-rate", type=float,
+                     default=MIN_ARTIFACT_HIT_RATE)
+    chk.add_argument("--wall-threshold", type=float,
+                     default=BOOT_WALL_THRESHOLD)
+    chk.add_argument("--wall-min-abs-s", type=float,
+                     default=BOOT_WALL_MIN_ABS_S)
+    chk.add_argument("--window", type=int, default=BASELINE_WINDOW)
+
+    ver = sub.add_parser("verify", help="verify an artifact's integrity")
+    ver.add_argument("--artifact", required=True, metavar="DIR")
+
+    warm = sub.add_parser("warm-tier1",
+                          help="copy the artifact cache into the tier-1 "
+                               ".jax_cache_cpu (make test-cache-warm)")
+    warm.add_argument("--artifact", required=True, metavar="DIR")
+    warm.add_argument("--dest", default=".jax_cache_cpu")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "verify":
+        try:
+            manifest = verify_artifact(args.artifact)
+        except (ValidationError, FileNotFoundError) as e:
+            print(f"boot: artifact verification FAILED: {e}",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps({k: manifest[k] for k in
+                          ("version", "backend", "n_programs",
+                           "configs", "n_devices")}, sort_keys=True))
+        return 0
+
+    if args.cmd == "warm-tier1":
+        try:
+            stats = warm_cache_dir(args.artifact, args.dest)
+        except (ValidationError, FileNotFoundError) as e:
+            print(f"boot: warm-tier1 FAILED: {e}", file=sys.stderr)
+            return 1
+        _log(f"warm-tier1: {stats['copied']} file(s) copied, "
+             f"{stats['skipped']} already present -> {args.dest}")
+        return 0
+
+    if args.cmd == "reconcile":
+        try:
+            manifest = verify_artifact(args.artifact)
+        except (ValidationError, FileNotFoundError) as e:
+            print(f"boot: artifact verification FAILED: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.report:
+            with open(args.report) as fh:
+                violations = reconcile(manifest, json.load(fh))
+        else:
+            violations = reconcile_ledger(manifest, args.ledger)
+            for entry, sig in stale_programs(manifest, args.ledger):
+                _log(f"stale-shipped: {entry} {sig} — shipped program "
+                     "never observed in this run")
+        for v in violations:
+            print(f"BOOT-VIOLATION: {v['kind']}: {v['entry']} "
+                  f"{v['sig']} ({v['detail']})", file=sys.stderr)
+        print(json.dumps({"ok": not violations,
+                          "manifest_version": manifest["version"],
+                          "n_violations": len(violations)}))
+        if violations:
+            return 1
+        _log(f"reconcile OK: observed ⊆ shipped "
+             f"(manifest {manifest['version']})")
+        return 0
+
+    if args.cmd == "run":
+        try:
+            manifest = verify_artifact(args.artifact)
+        except (ValidationError, FileNotFoundError) as e:
+            print(f"boot: artifact verification FAILED: {e}",
+                  file=sys.stderr)
+            return 1
+        modes = [m for m in args.modes.split(",") if m]
+        configs = [c for c in args.configs.split(",") if c]
+        rc = 0
+        good_rows = []
+        with tempfile.TemporaryDirectory(prefix="proovread_boot_") as tmp:
+            for cfg in configs:
+                for mode in modes:
+                    _log(f"config {cfg}: {mode} boot")
+                    report, wall = boot_once(
+                        cfg, mode, args.artifact, tmp,
+                        timeout=args.run_timeout,
+                        n_devices=manifest.get("n_devices"))
+                    row = boot_row(cfg, mode, report, wall,
+                                   manifest=manifest,
+                                   artifact=args.artifact)
+                    validate_boot_row(row, where=f"config {cfg} {mode}")
+                    print(json.dumps(row))
+                    if row["violations"]:
+                        # loud + rc 1, and the row is withheld from the
+                        # history: a known-violating measurement must
+                        # not become tomorrow's rolling baseline
+                        # (census prewarm's min-hit-rate discipline)
+                        for v in row["violations"]:
+                            print(f"BOOT-VIOLATION: {v['kind']}: "
+                                  f"{v['entry']} {v['sig']} "
+                                  f"({v['detail']})", file=sys.stderr)
+                        _log(f"FAILED: config {cfg} {mode} boot has "
+                             f"{len(row['violations'])} violation(s); "
+                             "row withheld from the history")
+                        rc = 1
+                        continue
+                    good_rows.append(row)
+        if args.out and good_rows:
+            with open(args.out, "a") as fh:
+                for row in good_rows:
+                    fh.write(json.dumps(row) + "\n")
+            _log(f"{len(good_rows)} row(s) appended to {args.out}")
+        return rc
+
+    # check
+    paths = _resolve_paths(args.files)
+    if not paths:
+        print("boot-check: no BOOT history files found", file=sys.stderr)
+        return 0
+    verdict = boot_check(load_rows(paths),
+                         min_hit_rate=args.min_hit_rate,
+                         wall_threshold=args.wall_threshold,
+                         wall_min_abs_s=args.wall_min_abs_s,
+                         window=args.window)
+    for c in verdict["checks"]:
+        if c["status"] == "regressed":
+            print(f"BOOT-REGRESSION: {c['check']} = {c['value']} vs "
+                  f"baseline {c['baseline']} (threshold "
+                  f"{c['threshold']})", file=sys.stderr)
+            for v in c.get("violations", ()):
+                print(f"BOOT-REGRESSION:   {v['kind']}: {v['entry']} "
+                      f"{v['sig']} ({v['detail']})", file=sys.stderr)
+        elif c["status"] == "missing":
+            print(f"boot-check: bad row — {c.get('note', c)}",
+                  file=sys.stderr)
+    print(json.dumps(verdict, sort_keys=True))
+    if verdict["verdict"] == "REGRESSION":
+        return 1
+    print(f"boot-check: {verdict['verdict']} "
+          f"({len(verdict['pools'])} pool(s))", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -460,6 +460,17 @@ def enable_persistent_cache(cache_dir: Optional[str] = None,
         cache_dir = default_cache_dir(backend)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # Path-independent cache keys: jax's default enables the
+    # xla_gpu_per_fusion_autotune_cache_dir side-cache, which embeds the
+    # cache directory's OWN PATH into every compile-options proto and
+    # therefore into every cache key — a cache populated at one path can
+    # then never hit from another, which breaks the shippable-artifact
+    # contract (analysis/factory.py: build once, copy anywhere,
+    # warm-boot). The side-cache is GPU-autotuner-only; on the CPU/TPU
+    # backends this serves, disabling it costs nothing and makes the
+    # artifact relocatable. tests/conftest.py sets the same, so tier-1's
+    # .jax_cache_cpu and a factory artifact share one key space.
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
     try:
         from jax._src import compilation_cache as _jax_cc
         _jax_cc.reset_cache()

@@ -1036,6 +1036,229 @@ def validate_load(row: Any, where: str = "LOAD row") -> Dict[str, Any]:
             "families": sorted(acc), "deaths": ho["deaths"]}
 
 
+# -- factory artifact manifest schema (analysis/factory.py writer) ---------
+# Same declaration discipline as the QC/SLO/LOAD schemas: declared here,
+# independently of the writer, validated two-sidedly (missing AND
+# undeclared fields fail), with a lint-guard round-trip test
+# (tests/test_boot.py) driving the writer against this declaration. The
+# manifest is the shipped-artifact contract — one row per compiled
+# program plus the full cache-file inventory obs/boot.py verifies
+# byte-for-byte before any replica trusts the artifact.
+MANIFEST_SCHEMA_VERSION = 1
+MANIFEST_TOP_FIELDS = {
+    "manifest_schema": (int,),
+    "version": (str,),             # content hash of the program set
+    "backend": (str,),
+    "interpret": _BOOL,
+    "configs": (list,),            # e.g. ["config4", "config3", "mini"]
+    "n_programs": (int,),
+    "compile_s": _NUM,
+    "wall_s": _NUM,
+    "n_devices": (int,),           # compile topology (cache-key input)
+    "jax_version": (str,),
+    "by_config": (dict,),
+    "files": (dict,),              # cache file -> exact byte size
+    "programs": (list,),
+}
+MANIFEST_ROW_FIELDS = {
+    "entry": (str,),               # registry entry (dmesh:* = salted)
+    "sig": (str,),                 # unsalted obs/compilecache.signature
+    "config": (str,),
+    "backend": (str,),
+    "compile_ms": _NUM,
+    "persistent": (str, type(None)),   # hit | miss | null (cache off)
+    "cache_key": (str, type(None)),    # cache file this compile landed
+    "artifact_bytes": (int,),
+}
+MANIFEST_BY_CONFIG_KEYS = ("n_programs", "compile_s",
+                           "backend_compiles", "wall_s")
+
+
+def validate_manifest(obj: Any, where: str = "manifest"
+                      ) -> Dict[str, Any]:
+    """Strictly validate a factory artifact manifest: two-sided schema
+    on the top level and every program row, the per-config rollup keyed
+    exactly by the declared configs, program counts reconciled, and
+    every attributed cache key present in the file inventory. Returns a
+    small summary."""
+    if not isinstance(obj, dict):
+        _fail(f"{where}: not an object")
+    if obj.get("manifest_schema") != MANIFEST_SCHEMA_VERSION:
+        _fail(f"{where}: manifest_schema != {MANIFEST_SCHEMA_VERSION}")
+    unknown = [k for k in obj if k not in MANIFEST_TOP_FIELDS]
+    missing = [k for k in MANIFEST_TOP_FIELDS if k not in obj]
+    if unknown or missing:
+        _fail(f"{where}: undeclared fields {unknown} / missing "
+              f"{missing} — declare in obs/validate.py:"
+              "MANIFEST_TOP_FIELDS first")
+    for k, types in MANIFEST_TOP_FIELDS.items():
+        if not isinstance(obj[k], types):
+            _fail(f"{where}: field {k!r} has type "
+                  f"{type(obj[k]).__name__}, expected one of "
+                  f"{[t.__name__ for t in types]}")
+    if not obj["version"]:
+        _fail(f"{where}: version must be non-empty")
+    for name, size in obj["files"].items():
+        if not isinstance(name, str) or not isinstance(size, int) \
+                or size < 0:
+            _fail(f"{where}: files must map name -> >=0 byte size "
+                  f"(bad entry {name!r}: {size!r})")
+    if obj["n_programs"] != len(obj["programs"]):
+        _fail(f"{where}: n_programs {obj['n_programs']} != "
+              f"{len(obj['programs'])} program row(s)")
+    cfg_counts: Dict[str, int] = {}
+    for i, row in enumerate(obj["programs"]):
+        rw = f"{where}: programs[{i}]"
+        if not isinstance(row, dict):
+            _fail(f"{rw}: not an object")
+        r_unknown = [k for k in row if k not in MANIFEST_ROW_FIELDS]
+        r_missing = [k for k in MANIFEST_ROW_FIELDS if k not in row]
+        if r_unknown or r_missing:
+            _fail(f"{rw}: undeclared fields {r_unknown} / missing "
+                  f"{r_missing} — declare in obs/validate.py:"
+                  "MANIFEST_ROW_FIELDS first")
+        for k, types in MANIFEST_ROW_FIELDS.items():
+            if not isinstance(row[k], types):
+                _fail(f"{rw}: field {k!r} has type "
+                      f"{type(row[k]).__name__}")
+        if row["persistent"] is not None \
+                and row["persistent"] not in LEDGER_PCACHE:
+            _fail(f"{rw}: persistent {row['persistent']!r} outside "
+                  f"{LEDGER_PCACHE}")
+        if row["compile_ms"] < 0 or row["artifact_bytes"] < 0:
+            _fail(f"{rw}: compile_ms/artifact_bytes must be >= 0")
+        if row["cache_key"] is not None \
+                and row["cache_key"] not in obj["files"]:
+            _fail(f"{rw}: cache_key {row['cache_key']!r} not in the "
+                  "file inventory")
+        cfg_counts[row["config"]] = cfg_counts.get(row["config"], 0) + 1
+    if sorted(obj["by_config"]) != sorted(obj["configs"]):
+        _fail(f"{where}: by_config keys {sorted(obj['by_config'])} != "
+              f"declared configs {sorted(obj['configs'])}")
+    for cfg, summary in obj["by_config"].items():
+        if not isinstance(summary, dict) or \
+                sorted(summary) != sorted(MANIFEST_BY_CONFIG_KEYS):
+            _fail(f"{where}: by_config[{cfg!r}] must have exactly keys "
+                  f"{MANIFEST_BY_CONFIG_KEYS}")
+        for k in MANIFEST_BY_CONFIG_KEYS:
+            if not isinstance(summary[k], _NUM) or summary[k] < 0:
+                _fail(f"{where}: by_config[{cfg!r}].{k} must be a >=0 "
+                      "number")
+        if summary["n_programs"] != cfg_counts.get(cfg, 0):
+            _fail(f"{where}: by_config[{cfg!r}].n_programs "
+                  f"{summary['n_programs']} != {cfg_counts.get(cfg, 0)} "
+                  "program row(s) for that config")
+    keys = [(r["entry"], r["sig"]) for r in obj["programs"]]
+    if len(set(keys)) != len(keys):
+        _fail(f"{where}: duplicate (entry, sig) program rows")
+    return {"version": obj["version"], "backend": obj["backend"],
+            "n_programs": obj["n_programs"],
+            "n_files": len(obj["files"]),
+            "artifact_bytes": sum(obj["files"].values())}
+
+
+# -- boot scoreboard row schema (obs/boot.py writer) ------------------------
+# One row per measured boot: a subprocess census walk (`boot run`, modes
+# cold/artifact) or an in-process replica start under a BootSpan
+# (serve/fleet.py). Same two-sided discipline; the itemized violations
+# carry a closed kind vocabulary so `make boot-check`'s absolute checks
+# stay machine-auditable.
+BOOT_SCHEMA_VERSION = 1
+BOOT_ROW_FIELDS = {
+    "metric": (str,),              # "boot"
+    "schema": (int,),
+    "config": (str,),              # config4 | config3 | mini | serve
+    "backend": (str,),
+    "mode": (str,),                # cold | artifact
+    "replica": (str, type(None)),  # fleet replica id, if any
+    "boot_wall_s": _NUM,
+    "compile_s": _NUM,
+    "n_backend_compiles": (int,),
+    "persistent_hits": (int,),
+    "persistent_misses": (int,),
+    "hit_rate": (int, float, type(None)),
+    "n_programs": (int,),
+    "violations": (list,),         # observed ⊄ shipped, itemized
+    "manifest_version": (str, type(None)),
+    "artifact": (str, type(None)),
+}
+BOOT_MODES = ("cold", "artifact")
+BOOT_VIOLATION_KINDS = ("compiled-at-boot", "unmanifested")
+BOOT_VIOLATION_FIELDS = {"kind": (str,), "entry": (str,),
+                         "sig": (str,), "detail": (str,)}
+
+
+def validate_boot_row(row: Any, where: str = "BOOT row") -> None:
+    """Strictly validate one boot scoreboard row: two-sided schema,
+    closed mode/violation vocabularies, the hit-rate consistency
+    identity (null iff no cache-mediated compiles, else
+    hits/(hits+misses)), and artifact-mode provenance (an artifact boot
+    must name the manifest version and artifact it booted from)."""
+    if not isinstance(row, dict):
+        _fail(f"{where}: not an object")
+    if row.get("metric") != "boot" \
+            or row.get("schema") != BOOT_SCHEMA_VERSION:
+        _fail(f"{where}: not a boot row with schema == "
+              f"{BOOT_SCHEMA_VERSION}")
+    unknown = [k for k in row if k not in BOOT_ROW_FIELDS]
+    missing = [k for k in BOOT_ROW_FIELDS if k not in row]
+    if unknown or missing:
+        _fail(f"{where}: undeclared fields {unknown} / missing "
+              f"{missing} — declare in obs/validate.py:BOOT_ROW_FIELDS "
+              "first")
+    for k, types in BOOT_ROW_FIELDS.items():
+        if not isinstance(row[k], types):
+            _fail(f"{where}: field {k!r} has type "
+                  f"{type(row[k]).__name__}, expected one of "
+                  f"{[t.__name__ for t in types]}")
+    if row["mode"] not in BOOT_MODES:
+        _fail(f"{where}: mode {row['mode']!r} outside {BOOT_MODES}")
+    for k in ("boot_wall_s", "compile_s"):
+        if row[k] < 0:
+            _fail(f"{where}: {k} must be >= 0")
+    for k in ("n_backend_compiles", "persistent_hits",
+              "persistent_misses", "n_programs"):
+        if row[k] < 0:
+            _fail(f"{where}: {k} must be >= 0")
+    hits, misses = row["persistent_hits"], row["persistent_misses"]
+    if hits + misses > row["n_backend_compiles"]:
+        _fail(f"{where}: persistent hits+misses {hits + misses} exceed "
+              f"n_backend_compiles {row['n_backend_compiles']}")
+    rate = row["hit_rate"]
+    if hits + misses == 0:
+        if rate is not None:
+            _fail(f"{where}: hit_rate must be null with no "
+                  "cache-mediated compiles")
+    else:
+        want = hits / (hits + misses)
+        if not isinstance(rate, _NUM) or abs(rate - want) > 1e-3:
+            _fail(f"{where}: hit_rate {rate!r} inconsistent with "
+                  f"hits/(hits+misses) = {want:.4f}")
+    for i, v in enumerate(row["violations"]):
+        vw = f"{where}: violations[{i}]"
+        if not isinstance(v, dict):
+            _fail(f"{vw}: not an object")
+        v_unknown = [k for k in v if k not in BOOT_VIOLATION_FIELDS]
+        v_missing = [k for k in BOOT_VIOLATION_FIELDS if k not in v]
+        if v_unknown or v_missing:
+            _fail(f"{vw}: undeclared fields {v_unknown} / missing "
+                  f"{v_missing}")
+        for k, types in BOOT_VIOLATION_FIELDS.items():
+            if not isinstance(v[k], types):
+                _fail(f"{vw}: field {k!r} has type "
+                      f"{type(v[k]).__name__}")
+        if v["kind"] not in BOOT_VIOLATION_KINDS:
+            _fail(f"{vw}: kind {v['kind']!r} outside "
+                  f"{BOOT_VIOLATION_KINDS}")
+    if row["mode"] == "artifact":
+        if row["manifest_version"] is None or row["artifact"] is None:
+            _fail(f"{where}: artifact-mode row must carry "
+                  "manifest_version and artifact provenance")
+    elif row["violations"]:
+        _fail(f"{where}: cold-mode row cannot carry violations "
+              "(reconciliation is an artifact-mode proof)")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="proovread-tpu-obs-validate",

@@ -58,6 +58,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
                          "the server's first-wave compile wall into "
                          "cache hits (docs/OBSERVABILITY.md 'Compile "
                          "ledger & census')")
+    ap.add_argument("--boot-from-artifact", metavar="DIR",
+                    help="warm-boot from a `make factory` artifact: "
+                         "verify it against its manifest, copy its "
+                         "compile cache under --state-dir, and write a "
+                         "boot row to <state-dir>/boot.json "
+                         "(docs/OBSERVABILITY.md 'Boot scoreboard'). "
+                         "Supersedes --compile-cache.")
     ap.add_argument("--max-tenant-jobs", type=int, default=8,
                     help="per-tenant held-job quota (queued + running)")
     ap.add_argument("--max-tenant-bases", type=int, default=4_000_000,
@@ -100,7 +107,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     from proovread_tpu.serve.admission import TenantQuota
     from proovread_tpu.serve.server import CorrectionServer, ServeConfig
 
-    if args.compile_cache:
+    if args.compile_cache and not args.boot_from_artifact:
         from proovread_tpu.obs.compilecache import enable_persistent_cache
         log.info("serve: persistent XLA compile cache at %s",
                  enable_persistent_cache(args.compile_cache))
@@ -131,6 +138,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         slo_path=args.slo_out,
         qc=args.qc,
         resume=args.resume,
+        artifact_dir=args.boot_from_artifact,
     )
     os.makedirs(args.state_dir, exist_ok=True)
     server = CorrectionServer(shorts, scfg, pcfg)

@@ -3,11 +3,24 @@
 ``FleetDispatcher`` runs N in-process :class:`CorrectionServer` replicas
 — each with its own ``AF_UNIX`` socket, state dir and worker thread —
 and routes jobs to them over the SAME wire protocol every other client
-uses (``serve/protocol.py``), never through an in-process shortcut. The
-replicas share one process-global compile-cache ledger, so replica 1's
-first wave reuses the programs replica 0 already traced: the fleet is
-warm from the shared cache, and the LOAD artifact's compile census
-proves it (``n_programs`` stays flat as replicas are added).
+uses (``serve/protocol.py``), never through an in-process shortcut.
+
+Warm boot is an *artifact*, not an accident of process topology: with
+``FleetConfig.artifact_dir`` set, the fleet downloads the factory
+artifact (``analysis/factory.py``) next to its state dir, verifies it
+byte-for-byte against the shipped manifest (``obs/boot.py:
+fetch_artifact``), points the persistent compile cache at the verified
+copy, and wraps every replica start in a ``BootSpan`` — one strict-
+schema boot row per replica lands in ``r<i>/boot.json``, itemizing any
+compile the artifact should have shipped. The earlier design leaned on
+the replicas sharing one in-process tracing cache (replica 1 reusing
+what replica 0 traced); that shared-process assumption does not survive
+real multi-process replicas, whereas the artifact warms ALL N replicas
+from disk regardless of where they run. The process-global compile
+ledger remains, now as the measurement instrument: the LOAD artifact's
+compile census and the per-replica boot rows prove the warm boot
+instead of assuming it (``n_programs`` stays flat as replicas are
+added, backend compiles at boot stay ~zero).
 
 Design decisions worth naming:
 
@@ -94,6 +107,12 @@ class FleetConfig:
     # forwarded verbatim to every replica (job/device sites)
     replica_fault_spec: Optional[str] = None
     qc: bool = False
+    # factory artifact to warm-boot every replica from (analysis/
+    # factory.py): verified + copied under state_dir at start(), the
+    # persistent compile cache pointed at the copy, one boot row per
+    # replica written to r<i>/boot.json. None = no artifact, replicas
+    # boot cold (and no boot machinery is even imported).
+    artifact_dir: Optional[str] = None
 
 
 class Replica:
@@ -163,6 +182,36 @@ class FleetDispatcher:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        manifest = None
+        if self.cfg.artifact_dir:
+            # warm boot: download + verify the factory artifact ONCE per
+            # fleet (the "download" step a real deployment pays per
+            # node), then point the persistent cache at the verified
+            # copy so every replica's compiles land as hits. Lazy import
+            # on purpose — the artifact-less path never touches boot
+            # machinery (test_boot_zero_overhead_when_off).
+            import json as _json
+
+            from proovread_tpu.obs import boot as obs_boot
+            from proovread_tpu.obs import compilecache
+            from proovread_tpu.obs.validate import validate_boot_row
+            cache_copy = os.path.join(self.cfg.state_dir,
+                                      "artifact_cache")
+            try:
+                manifest = obs_boot.fetch_artifact(self.cfg.artifact_dir,
+                                                   cache_copy)
+            except Exception:
+                # a fleet that refuses to boot must not leave the
+                # ledger it installed in __init__ behind in the process
+                if (self._ledger_owned
+                        and compilecache.current() is self.ledger):
+                    compilecache.uninstall()
+                    self._ledger_owned = False
+                raise
+            compilecache.enable_persistent_cache(cache_copy)
+            log.info("fleet: warm-boot artifact %s (%d programs) "
+                     "verified -> %s", manifest["version"],
+                     manifest["n_programs"], cache_copy)
         for i in range(self.cfg.n_replicas):
             rep = Replica(
                 i, os.path.join(self.cfg.state_dir, f"r{i}"),
@@ -174,10 +223,26 @@ class FleetDispatcher:
                 job_retries=self.cfg.job_retries,
                 fault_spec=self.cfg.replica_fault_spec,
                 qc=self.cfg.qc, replica_id=rep.replica_id)
+            span = (obs_boot.BootSpan(self.ledger)
+                    if manifest is not None else None)
             rep.server = CorrectionServer(self.short_records, scfg,
                                           self.pipeline_config)
             rep.server.start(worker=True)
             rep.alive = True
+            if span is not None:
+                row = span.row(config="serve", mode="artifact",
+                               manifest=manifest,
+                               artifact=self.cfg.artifact_dir,
+                               replica=rep.replica_id)
+                validate_boot_row(row, where=f"{rep.replica_id} boot")
+                with open(os.path.join(rep.state_dir, "boot.json"),
+                          "w") as fh:
+                    fh.write(_json.dumps(row) + "\n")
+                log.info("fleet: %s booted from artifact in %.3fs "
+                         "(%d backend compile(s), %d violation(s))",
+                         rep.replica_id, row["boot_wall_s"],
+                         row["n_backend_compiles"],
+                         len(row["violations"]))
             self.replicas.append(rep)
         log.info("fleet: %d replica(s) up under %s",
                  len(self.replicas), self.cfg.state_dir)

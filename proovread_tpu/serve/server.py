@@ -85,6 +85,13 @@ class ServeConfig:
     # stable identity on the ping probe — the fleet dispatcher assigns
     # "r0".."rN-1"; empty derives a per-process default
     replica_id: str = ""
+    # factory artifact to warm-boot from (analysis/factory.py): verified
+    # + copied under state_dir, the persistent compile cache pointed at
+    # the copy, and a boot row written to <state_dir>/boot.json. None =
+    # cold boot, no boot machinery imported. The fleet dispatcher does
+    # its own fetch once per fleet (serve/fleet.py) and leaves this
+    # unset on replica configs.
+    artifact_dir: Optional[str] = None
 
 
 class CorrectionServer:
@@ -159,6 +166,39 @@ class CorrectionServer:
         from proovread_tpu.obs import compilecache
         self._ledger_owned = compilecache.current() is None
         self.ledger = compilecache.current() or compilecache.install()
+
+        self.boot_manifest = None
+        if config.artifact_dir:
+            # standalone warm boot: verify + copy the factory artifact,
+            # point the persistent cache at the copy, and record the
+            # boot as a measured event (obs/boot.py BootSpan) — the
+            # row lands in <state_dir>/boot.json like a fleet replica's
+            from proovread_tpu.obs import boot as obs_boot
+            from proovread_tpu.obs.validate import validate_boot_row
+            span = obs_boot.BootSpan(self.ledger)
+            copy = os.path.join(config.state_dir, "artifact_cache")
+            try:
+                self.boot_manifest = obs_boot.fetch_artifact(
+                    config.artifact_dir, copy)
+            except Exception:
+                # a server that refuses to boot must not leave its
+                # ledger installation behind in the process
+                self._release_ledger()
+                raise
+            compilecache.enable_persistent_cache(copy)
+            row = span.row(config="serve", mode="artifact",
+                           manifest=self.boot_manifest,
+                           artifact=config.artifact_dir,
+                           replica=self.replica_id)
+            validate_boot_row(row, where=f"{self.replica_id} boot")
+            with open(os.path.join(config.state_dir, "boot.json"),
+                      "w") as fh:
+                fh.write(json.dumps(row) + "\n")
+            log.info("serve: booted from artifact %s (%d programs "
+                     "shipped, %d violation(s))",
+                     self.boot_manifest["version"],
+                     self.boot_manifest["n_programs"],
+                     len(row["violations"]))
 
         self._threads: List[threading.Thread] = []
         self._listener: Optional[socket.socket] = None

@@ -23,3 +23,9 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_compilation_cache_dir",
                   os.path.join(os.path.dirname(__file__), "..", ".jax_cache_cpu"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# path-independent cache keys (same setting as obs/compilecache.py:
+# enable_persistent_cache and the same rationale): the default
+# xla_gpu_per_fusion_autotune_cache_dir side-cache embeds the cache
+# dir's own path into every key, so a factory artifact could never warm
+# this cache (`make test-cache-warm`) nor vice versa
+jax.config.update("jax_persistent_cache_enable_xla_caches", "none")

@@ -1,0 +1,644 @@
+"""Warm-boot observability tests (docs/OBSERVABILITY.md "Boot
+scoreboard"): the factory manifest's strict two-sided schema driven by
+the real writer, artifact verification falsifiability, observed ⊆
+shipped reconciliation (tampered manifest and unmanifested compile must
+both fail), the boot-check gate's absolute-first-row and rolling-
+baseline checks on synthetic rows, the fleet boot-from-artifact path
+with per-replica boot rows, the zero-overhead-when-off contract, and
+the FACTORY_CONFIGS / bench.py keep-in-sync lint.
+
+The module-scoped ``artifact`` fixture builds a REAL two-entry mini
+artifact in-process (~1 s: the miniature tier-1 shapes compile in
+milliseconds); the full subprocess cold-vs-artifact boot measurement is
+``slow``-marked."""
+
+import json
+import os
+import re
+import shutil
+import sys
+
+import pytest
+
+from proovread_tpu.analysis import factory
+from proovread_tpu.analysis.predict import FACTORY_CONFIGS
+from proovread_tpu.io.simulate import random_genome, simulate_short_reads
+from proovread_tpu.obs import boot, census
+from proovread_tpu.obs.load import FleetScoreboard
+from proovread_tpu.obs.validate import (BOOT_ROW_FIELDS,
+                                        MANIFEST_ROW_FIELDS,
+                                        MANIFEST_TOP_FIELDS,
+                                        ValidationError,
+                                        validate_boot_row,
+                                        validate_manifest)
+from proovread_tpu.serve.fleet import FleetConfig, FleetDispatcher
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# two cheap registry entries: the whole fixture artifact compiles in ~1 s
+ENTRIES = ["hcr_mask_rows", "call_consensus"]
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    """A real factory artifact (mini walk, two cheap entries) built
+    in-process; the persistent-cache config is restored so the rest of
+    the suite keeps writing to .jax_cache_cpu."""
+    import jax
+    old = jax.config.jax_compilation_cache_dir
+    art = str(tmp_path_factory.mktemp("boot") / "artifact")
+    try:
+        manifest = factory.build_artifact(art, [], mini=True,
+                                          entries=ENTRIES)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+    return art, manifest
+
+
+@pytest.fixture
+def restore_cache_config():
+    import jax
+    old = jax.config.jax_compilation_cache_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", old)
+
+
+def _copy_artifact(art, tmp_path):
+    dst = str(tmp_path / "artifact_copy")
+    shutil.copytree(art, dst)
+    return dst
+
+
+# --------------------------------------------------------------------------
+# manifest schema: round-trip + two-sided drift guard
+# --------------------------------------------------------------------------
+
+class TestManifestSchema:
+    def test_written_manifest_round_trips_and_validates(self, artifact):
+        art, built = artifact
+        manifest = boot.load_manifest(art)      # validates strictly
+        assert manifest["version"] == built["version"]
+        assert manifest["n_programs"] == len(ENTRIES)
+        assert manifest["configs"] == ["mini"]
+        assert manifest["n_devices"] == 8       # the tier-1 topology
+        s = validate_manifest(manifest)
+        assert s["n_files"] == len(manifest["files"]) > 0
+
+    def test_writer_and_declaration_agree_both_ways(self, artifact):
+        """The drift guard: the REAL writer's output must carry exactly
+        the declared fields — a field added to either side without the
+        other fails here, not in production."""
+        _, manifest = artifact
+        assert set(manifest) == set(MANIFEST_TOP_FIELDS)
+        for row in manifest["programs"]:
+            assert set(row) == set(MANIFEST_ROW_FIELDS)
+
+    def test_undeclared_top_field_fails(self, artifact):
+        _, manifest = artifact
+        bad = dict(manifest, surprise=1)
+        with pytest.raises(ValidationError, match="undeclared"):
+            validate_manifest(bad)
+
+    def test_missing_top_field_fails(self, artifact):
+        _, manifest = artifact
+        bad = {k: v for k, v in manifest.items() if k != "n_devices"}
+        with pytest.raises(ValidationError, match="missing"):
+            validate_manifest(bad)
+
+    def test_undeclared_row_field_fails(self, artifact):
+        _, manifest = artifact
+        bad = json.loads(json.dumps(manifest))
+        bad["programs"][0]["extra"] = True
+        with pytest.raises(ValidationError, match="undeclared"):
+            validate_manifest(bad)
+
+    def test_program_count_identity_enforced(self, artifact):
+        _, manifest = artifact
+        bad = json.loads(json.dumps(manifest))
+        bad["n_programs"] += 1
+        with pytest.raises(ValidationError, match="n_programs"):
+            validate_manifest(bad)
+
+    def test_cache_key_must_be_in_inventory(self, artifact):
+        _, manifest = artifact
+        bad = json.loads(json.dumps(manifest))
+        bad["programs"][0]["cache_key"] = "jit_nope-deadbeef-cache"
+        with pytest.raises(ValidationError, match="inventory"):
+            validate_manifest(bad)
+
+    def test_version_is_content_hash_of_program_set(self, artifact):
+        _, manifest = artifact
+        again = factory.manifest_version(manifest["programs"],
+                                         manifest["backend"])
+        assert again == manifest["version"]
+
+
+# --------------------------------------------------------------------------
+# artifact verification falsifiability
+# --------------------------------------------------------------------------
+
+class TestVerifyArtifact:
+    def test_pristine_artifact_verifies(self, artifact):
+        art, _ = artifact
+        assert boot.verify_artifact(art)["version"]
+
+    def test_missing_cache_file_fails(self, artifact, tmp_path):
+        art, manifest = artifact
+        dst = _copy_artifact(art, tmp_path)
+        victim = sorted(manifest["files"])[0]
+        os.unlink(os.path.join(dst, "cache", victim))
+        with pytest.raises(ValidationError, match="missing cache file"):
+            boot.verify_artifact(dst)
+
+    def test_truncated_cache_file_fails(self, artifact, tmp_path):
+        art, manifest = artifact
+        dst = _copy_artifact(art, tmp_path)
+        victim = sorted(manifest["files"])[0]
+        with open(os.path.join(dst, "cache", victim), "w") as fh:
+            fh.write("x")
+        with pytest.raises(ValidationError, match="manifest says"):
+            boot.verify_artifact(dst)
+
+    def test_unmanifested_extra_file_fails(self, artifact, tmp_path):
+        art, _ = artifact
+        dst = _copy_artifact(art, tmp_path)
+        with open(os.path.join(dst, "cache", "stowaway-cache"),
+                  "w") as fh:
+            fh.write("compiled after shipping")
+        with pytest.raises(ValidationError, match="unmanifested"):
+            boot.verify_artifact(dst)
+
+    def test_torn_build_without_manifest_fails(self, tmp_path):
+        os.makedirs(tmp_path / "torn" / "cache")
+        with pytest.raises(FileNotFoundError, match="not a factory"):
+            boot.load_manifest(str(tmp_path / "torn"))
+
+    def test_fetch_copies_and_reverifies(self, artifact, tmp_path):
+        art, manifest = artifact
+        dest = str(tmp_path / "replica_cache")
+        got = boot.fetch_artifact(art, dest)
+        assert got["version"] == manifest["version"]
+        for name, size in manifest["files"].items():
+            assert os.path.getsize(os.path.join(dest, name)) == size
+
+    def test_warm_cache_dir_is_idempotent(self, artifact, tmp_path):
+        art, manifest = artifact
+        dest = str(tmp_path / "tier1_cache")
+        first = boot.warm_cache_dir(art, dest)
+        assert first["copied"] == len(manifest["files"])
+        second = boot.warm_cache_dir(art, dest)
+        assert second["copied"] == 0
+        assert second["skipped"] == len(manifest["files"])
+
+
+# --------------------------------------------------------------------------
+# reconciliation: observed ⊆ shipped, falsifiable both ways
+# --------------------------------------------------------------------------
+
+def _report_from(manifest, *, outcome="hit", extra_program=None):
+    rows = [{"kind": "backend_compile", "entry": p["entry"],
+             "sig": p["sig"], "persistent_cache": outcome,
+             "compile_ms": 1.0} for p in manifest["programs"]]
+    programs = [{"entry": p["entry"], "sig": p["sig"]}
+                for p in manifest["programs"]]
+    if extra_program is not None:
+        programs.append(extra_program)
+    return {"rows": rows, "programs": programs}
+
+
+class TestReconcile:
+    def test_clean_boot_reconciles_rc0(self, artifact, tmp_path):
+        art, manifest = artifact
+        rep = tmp_path / "report.json"
+        rep.write_text(json.dumps(_report_from(manifest)))
+        assert boot.main(["reconcile", "--artifact", art,
+                          "--report", str(rep)]) == 0
+
+    def test_compiled_at_boot_is_rc1(self, artifact, tmp_path, capsys):
+        art, manifest = artifact
+        rep = tmp_path / "report.json"
+        rep.write_text(json.dumps(_report_from(manifest,
+                                               outcome="miss")))
+        assert boot.main(["reconcile", "--artifact", art,
+                          "--report", str(rep)]) == 1
+        err = capsys.readouterr().err
+        assert "BOOT-VIOLATION: compiled-at-boot" in err
+
+    def test_unmanifested_compile_is_rc1(self, artifact, tmp_path,
+                                         capsys):
+        art, manifest = artifact
+        rep = tmp_path / "report.json"
+        rep.write_text(json.dumps(_report_from(
+            manifest,
+            extra_program={"entry": "rogue_entry", "sig": "f00d"})))
+        assert boot.main(["reconcile", "--artifact", art,
+                          "--report", str(rep)]) == 1
+        err = capsys.readouterr().err
+        assert "BOOT-VIOLATION: unmanifested: rogue_entry" in err
+
+    def test_tampered_manifest_row_is_rc1(self, artifact, tmp_path):
+        """Editing one shipped sig makes the honest boot report look
+        unmanifested — the manifest cannot be quietly rewritten under a
+        shipped cache."""
+        art, manifest = artifact
+        dst = _copy_artifact(art, tmp_path)
+        tampered = json.loads(json.dumps(manifest))
+        tampered["programs"][0]["sig"] = "0" * 12
+        with open(os.path.join(dst, "manifest.json"), "w") as fh:
+            json.dump(tampered, fh)
+        rep = tmp_path / "report.json"
+        rep.write_text(json.dumps(_report_from(manifest)))
+        assert boot.main(["reconcile", "--artifact", dst,
+                          "--report", str(rep)]) == 1
+
+    def test_pin_topology_matches_manifest_device_count(self):
+        """Topology is part of every XLA cache key: a boot child under
+        a different host device count misses the WHOLE shipped cache
+        (hit rate 0.0, observed in the first real recording run)."""
+        env = boot.pin_topology({"XLA_FLAGS": "--foo"}, 8)
+        assert env["XLA_FLAGS"] == \
+            "--foo --xla_force_host_platform_device_count=8"
+        pinned = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+        assert boot.pin_topology(pinned, 8) is pinned
+        bare = {}
+        assert boot.pin_topology(bare, None) is bare
+
+    def test_dmesh_salt_stripped_before_lookup(self):
+        assert boot._strip_salt("dmesh:step", "v3.abcd1234") == "abcd1234"
+        # unsalted entries pass through untouched
+        assert boot._strip_salt("fused_pass", "abcd1234") == "abcd1234"
+        assert boot._strip_salt("fused_pass", "v3.abcd") == "v3.abcd"
+
+    def test_reconcile_ledger_and_stale_programs(self, tmp_path):
+        manifest = {"programs": [
+            {"entry": "dmesh:step", "sig": "aa11"},
+            {"entry": "fused_pass", "sig": "bb22"},
+            {"entry": "never_run", "sig": "cc33"}]}
+        led = tmp_path / "LEDGER_x.jsonl"
+        led.write_text("\n".join([
+            json.dumps({"meta": True}),
+            json.dumps({"kind": "retrace", "entry": "dmesh:step",
+                        "sig": "v7.aa11"}),          # salted, shipped
+            json.dumps({"kind": "retrace", "entry": "fused_pass",
+                        "sig": "bb22"}),             # shipped
+            json.dumps({"kind": "retrace", "entry": "fused_pass",
+                        "sig": "dd44"}),             # never shipped
+            json.dumps({"kind": "retrace", "entry": "(unattributed)",
+                        "sig": "ee55"}),             # skipped
+            json.dumps({"kind": "backend_compile", "entry": "x",
+                        "sig": "ff66"})]) + "\n")    # not a retrace
+        violations = boot.reconcile_ledger(manifest, str(led))
+        assert [(v["entry"], v["sig"]) for v in violations] == \
+            [("fused_pass", "dd44")]
+        assert boot.stale_programs(manifest, str(led)) == \
+            [("never_run", "cc33")]
+
+
+# --------------------------------------------------------------------------
+# BOOT row schema falsifiability
+# --------------------------------------------------------------------------
+
+def _boot_row(**over):
+    row = {"metric": "boot", "schema": 1, "config": "mini",
+           "backend": "cpu", "mode": "artifact", "replica": None,
+           "boot_wall_s": 10.0, "compile_s": 1.0,
+           "n_backend_compiles": 2, "persistent_hits": 2,
+           "persistent_misses": 0, "hit_rate": 1.0, "n_programs": 2,
+           "violations": [], "manifest_version": "abc",
+           "artifact": "artifact"}
+    row.update(over)
+    return row
+
+
+class TestBootRowSchema:
+    def test_good_row_validates(self):
+        validate_boot_row(_boot_row())
+
+    def test_declared_fields_exactly(self):
+        assert set(_boot_row()) == set(BOOT_ROW_FIELDS)
+
+    def test_undeclared_field_fails(self):
+        with pytest.raises(ValidationError, match="undeclared"):
+            validate_boot_row(_boot_row(surprise=1))
+
+    def test_missing_field_fails(self):
+        row = _boot_row()
+        del row["hit_rate"]
+        with pytest.raises(ValidationError, match="missing"):
+            validate_boot_row(row)
+
+    def test_mode_vocabulary_closed(self):
+        with pytest.raises(ValidationError, match="mode"):
+            validate_boot_row(_boot_row(mode="lukewarm"))
+
+    def test_hit_rate_identity_enforced(self):
+        with pytest.raises(ValidationError, match="hit_rate"):
+            validate_boot_row(_boot_row(hit_rate=0.5))
+        with pytest.raises(ValidationError, match="hit_rate"):
+            validate_boot_row(_boot_row(n_backend_compiles=0,
+                                        persistent_hits=0,
+                                        persistent_misses=0,
+                                        hit_rate=1.0))
+
+    def test_artifact_mode_needs_provenance(self):
+        with pytest.raises(ValidationError, match="provenance"):
+            validate_boot_row(_boot_row(manifest_version=None))
+
+    def test_cold_mode_cannot_carry_violations(self):
+        with pytest.raises(ValidationError, match="cold-mode"):
+            validate_boot_row(_boot_row(
+                mode="cold", manifest_version=None, artifact=None,
+                persistent_hits=0, persistent_misses=2, hit_rate=0.0,
+                violations=[{"kind": "unmanifested", "entry": "x",
+                             "sig": "y", "detail": "z"}]))
+
+    def test_violation_kind_vocabulary_closed(self):
+        with pytest.raises(ValidationError, match="kind"):
+            validate_boot_row(_boot_row(
+                violations=[{"kind": "mystery", "entry": "x",
+                             "sig": "y", "detail": "z"}]))
+
+
+# --------------------------------------------------------------------------
+# the gate: absolute first-row checks + rolling wall baseline
+# --------------------------------------------------------------------------
+
+def _entries(*rows):
+    return [{"source": f"BOOT_t{i}.json", "row": r}
+            for i, r in enumerate(rows)]
+
+
+class TestBootCheckGate:
+    def test_clean_first_row_passes(self):
+        v = boot.boot_check(_entries(_boot_row()))
+        assert v["verdict"] == "PASS"
+        assert any(c["check"].endswith(":baseline")
+                   and c["status"] == "skipped" for c in v["checks"])
+
+    def test_violation_fires_on_first_row(self):
+        row = _boot_row(violations=[{"kind": "compiled-at-boot",
+                                     "entry": "x", "sig": "y",
+                                     "detail": "persistent_cache=miss"}])
+        v = boot.boot_check(_entries(row))
+        assert v["verdict"] == "REGRESSION"
+        (c,) = [c for c in v["checks"]
+                if c["check"].endswith(":violations")]
+        assert c["status"] == "regressed" and c["value"] == 1
+
+    def test_hit_rate_floor_fires_on_first_row(self):
+        row = _boot_row(persistent_hits=1, persistent_misses=1,
+                        hit_rate=0.5)
+        v = boot.boot_check(_entries(row))
+        assert v["verdict"] == "REGRESSION"
+        (c,) = [c for c in v["checks"]
+                if c["check"].endswith(":hit_rate")]
+        assert c["status"] == "regressed"
+
+    def test_zero_compile_boot_is_the_perfect_warm_boot(self):
+        row = _boot_row(n_backend_compiles=0, persistent_hits=0,
+                        persistent_misses=0, hit_rate=None,
+                        compile_s=0.0)
+        v = boot.boot_check(_entries(row))
+        assert v["verdict"] == "PASS"
+        (c,) = [c for c in v["checks"]
+                if c["check"].endswith(":hit_rate")]
+        assert c["status"] == "ok" and "0 backend compiles" in c["note"]
+
+    def test_boot_wall_regression_vs_rolling_baseline(self):
+        rows = [_boot_row(boot_wall_s=w) for w in (10.0, 10.5, 9.8)]
+        ok = boot.boot_check(_entries(*rows, _boot_row(boot_wall_s=12.0)))
+        assert ok["verdict"] == "PASS"      # +2 s < 5 s absolute floor
+        bad = boot.boot_check(_entries(*rows,
+                                       _boot_row(boot_wall_s=20.0)))
+        assert bad["verdict"] == "REGRESSION"
+        (c,) = [c for c in bad["checks"]
+                if c["check"].endswith(":boot_wall_s")]
+        assert c["status"] == "regressed"
+
+    def test_cold_rows_gate_wall_too_but_not_hit_rate(self):
+        cold = [_boot_row(mode="cold", manifest_version=None,
+                          artifact=None, persistent_hits=0,
+                          persistent_misses=2, hit_rate=0.0,
+                          boot_wall_s=w) for w in (10.0, 10.0, 40.0)]
+        v = boot.boot_check(_entries(*cold))
+        assert v["verdict"] == "REGRESSION"
+        assert not any(c["check"].endswith(":hit_rate")
+                       for c in v["checks"])
+
+    def test_pools_split_by_mode_and_config(self):
+        v = boot.boot_check(_entries(
+            _boot_row(),
+            _boot_row(mode="cold", manifest_version=None, artifact=None,
+                      persistent_hits=0, persistent_misses=2,
+                      hit_rate=0.0),
+            _boot_row(config="config4")))
+        assert sorted(v["pools"]) == ["config4/cpu/artifact",
+                                      "mini/cpu/artifact",
+                                      "mini/cpu/cold"]
+
+    def test_invalid_row_is_surfaced_not_pooled(self):
+        v = boot.boot_check(_entries({"metric": "boot", "schema": 1}))
+        assert v["verdict"] == "NO-DATA"
+        assert v["checks"][0]["status"] == "missing"
+
+    def test_load_rows_accepts_json_and_jsonl(self, tmp_path):
+        one = tmp_path / "BOOT_one.json"
+        one.write_text(json.dumps(_boot_row()))
+        many = tmp_path / "BOOT_many.json"
+        many.write_text(json.dumps(_boot_row()) + "\n"
+                        + json.dumps(_boot_row()) + "\n")
+        assert len(boot.load_rows([str(one), str(many)])) == 3
+
+
+# --------------------------------------------------------------------------
+# fleet warm boot (in-process e2e) + the zero-overhead contract
+# --------------------------------------------------------------------------
+
+def _mini_fleet(tmp_path, **cfg_over):
+    genome = random_genome(400, seed=1)
+    shorts = simulate_short_reads(genome, 5.0, seed=2)
+    cfg = FleetConfig(state_dir=str(tmp_path / "fleet"), n_replicas=2,
+                      heartbeat_s=0.05, suspect_after=2,
+                      stall_timeout_s=0.5)
+    for k, v in cfg_over.items():
+        setattr(cfg, k, v)
+    disp = FleetDispatcher(shorts, cfg, scoreboard=FleetScoreboard())
+    disp.start()
+    return disp
+
+
+class TestFleetWarmBoot:
+    def test_every_replica_boots_from_artifact_with_a_row(
+            self, tmp_path, artifact, restore_cache_config):
+        import jax
+        art, manifest = artifact
+        disp = _mini_fleet(tmp_path, artifact_dir=art)
+        try:
+            # the download step: ONE verified copy under the fleet state
+            copy = tmp_path / "fleet" / "artifact_cache"
+            for name, size in manifest["files"].items():
+                assert os.path.getsize(copy / name) == size
+            assert "artifact_cache" in \
+                str(jax.config.jax_compilation_cache_dir)
+            for i in range(2):
+                p = tmp_path / "fleet" / f"r{i}" / "boot.json"
+                row = json.loads(p.read_text())
+                validate_boot_row(row, where=str(p))
+                assert row["mode"] == "artifact"
+                assert row["config"] == "serve"
+                assert row["replica"] == f"r{i}"
+                assert row["manifest_version"] == manifest["version"]
+                assert row["violations"] == []
+        finally:
+            disp.close()
+
+    def test_tampered_artifact_never_boots_a_fleet(self, tmp_path,
+                                                   artifact):
+        from proovread_tpu.obs import compilecache
+        art, manifest = artifact
+        dst = _copy_artifact(art, tmp_path)
+        victim = sorted(manifest["files"])[0]
+        os.unlink(os.path.join(dst, "cache", victim))
+        with pytest.raises(ValidationError, match="missing cache file"):
+            _mini_fleet(tmp_path, artifact_dir=dst)
+        # the refused boot must not leak the dispatcher's process-wide
+        # ledger installation into the rest of the process
+        assert compilecache.current() is None
+
+    def test_boot_zero_overhead_when_off(self, tmp_path):
+        """No artifact configured -> the boot machinery is never even
+        imported and no boot rows appear."""
+        saved = sys.modules.pop("proovread_tpu.obs.boot", None)
+        try:
+            disp = _mini_fleet(tmp_path)
+            try:
+                assert "proovread_tpu.obs.boot" not in sys.modules
+                assert not (tmp_path / "fleet" / "r0"
+                            / "boot.json").exists()
+            finally:
+                disp.close()
+        finally:
+            if saved is not None:
+                sys.modules["proovread_tpu.obs.boot"] = saved
+
+
+# --------------------------------------------------------------------------
+# FACTORY_CONFIGS / bench.py keep-in-sync lint
+# --------------------------------------------------------------------------
+
+class TestFactoryConfigsLint:
+    def test_factory_configs_are_bench_ladder_rungs(self):
+        """LOUD keep-in-sync lint: analysis/predict.py:FACTORY_CONFIGS
+        must stay a subset of bench.py's --config ladder. Extending the
+        ladder? Decide whether the new rung is simulated/self-contained
+        and update FACTORY_CONFIGS + census._build_workload together."""
+        src = open(os.path.join(ROOT, "bench.py")).read()
+        m = re.search(r'"--config",\s*type=int,\s*default=\d+,'
+                      r'\s*choices=\(([^)]*)\)', src)
+        assert m, ("bench.py's --config declaration moved — update this "
+                   "lint AND analysis/predict.py:FACTORY_CONFIGS")
+        bench_cfgs = {int(x) for x in re.findall(r"\d+", m.group(1))}
+        assert set(FACTORY_CONFIGS) <= bench_cfgs, (
+            f"FACTORY_CONFIGS {FACTORY_CONFIGS} names configs bench.py "
+            f"does not ladder ({sorted(bench_cfgs)})")
+
+    def test_workload_builds_for_every_factory_config(self):
+        for cfg in FACTORY_CONFIGS:
+            cap = 84_000 if cfg == 3 else None
+            longs, srs, _ = census._build_workload(cfg, cap)
+            assert longs and srs
+
+    def test_workload_refuses_non_factory_configs_loudly(self):
+        for cfg in (1, 2, 5):
+            with pytest.raises(ValueError, match="FACTORY_CONFIGS"):
+                census._build_workload(cfg, None)
+
+    def test_factory_cli_rejects_non_factory_configs(self, tmp_path):
+        with pytest.raises(SystemExit):
+            factory.main(["--configs", "9",
+                          "--artifact", str(tmp_path / "a")])
+
+
+# --------------------------------------------------------------------------
+# census --from-artifact plumbing (the heavy run is `make prewarm`)
+# --------------------------------------------------------------------------
+
+class TestArtifactPrewarm:
+    def test_refuses_configs_the_artifact_does_not_ship(self, artifact):
+        _, manifest = artifact        # mini-only artifact
+        with pytest.raises(ValueError, match="does not ship config4"):
+            census.artifact_prewarm_config(4, manifest, "unused",
+                                           artifact_dir="unused")
+
+    def test_shipped_hit_rate_ignores_unattributed_glue(
+            self, artifact, tmp_path):
+        """A real run backend-compiles small glue programs the census
+        never predicts; the gated rate covers shipped programs only."""
+        _, manifest = artifact
+        p0, p1 = manifest["programs"][0], manifest["programs"][1]
+        lines = [
+            {"meta": True},
+            {"kind": "backend_compile", "entry": p0["entry"],
+             "sig": p0["sig"], "persistent_cache": "hit"},
+            {"kind": "backend_compile", "entry": "(unattributed)",
+             "sig": "-", "persistent_cache": "miss"},
+            {"kind": "backend_compile", "entry": "(unattributed)",
+             "sig": "-", "persistent_cache": "miss"},
+            {"kind": "retrace", "entry": p0["entry"], "sig": p0["sig"]},
+        ]
+        led = tmp_path / "warm.ledger.jsonl"
+        led.write_text("\n".join(json.dumps(x) for x in lines) + "\n")
+        assert census._shipped_hit_rate(manifest, str(led)) == 1.0
+        lines.append({"kind": "backend_compile", "entry": p1["entry"],
+                      "sig": p1["sig"], "persistent_cache": "miss"})
+        led.write_text("\n".join(json.dumps(x) for x in lines) + "\n")
+        assert census._shipped_hit_rate(manifest, str(led)) == 0.5
+
+    def test_from_artifact_conflicts_with_fresh(self, capsys):
+        assert census.main(["prewarm", "--from-artifact", "x",
+                            "--fresh"]) == 2
+        assert "--from-artifact" in capsys.readouterr().err
+
+    def test_synthesized_cold_rows_pool_in_compile_check(self):
+        base = {"metric": "compile_census", "schema": 1, "config": 4,
+                "backend": "cpu", "cache_hit_rate": 1.0,
+                "artifact": {"dir": "a", "version": "v",
+                             "cold_synthesized": True},
+                "cold": {"wall_s": 30.0, "compile_s": 25.0,
+                         "n_programs": 40, "backend_compiles": 45,
+                         "persistent_hit_rate": None},
+                "warm": {"wall_s": 5.0, "compile_s": 0.1,
+                         "n_programs": 40, "backend_compiles": 45,
+                         "persistent_hit_rate": 1.0}}
+        rows = [{"source": "COMPILE_a.json", "row": base},
+                {"source": "COMPILE_b.json",
+                 "row": json.loads(json.dumps(base))}]
+        v = census.compile_check(rows)
+        assert v["verdict"] == "PASS"
+        assert v["pools"] == ["config4/cpu"]
+
+
+# --------------------------------------------------------------------------
+# the measured thing itself: cold vs artifact subprocess boots (@slow)
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestMeasuredBoot:
+    def test_cold_vs_artifact_boot_end_to_end(self, artifact, tmp_path):
+        art, manifest = artifact
+        out = tmp_path / "BOOT_e2e.json"
+        cfg = "mini:" + "+".join(ENTRIES)
+        rc = boot.main(["run", "--artifact", art, "--configs", cfg,
+                        "--modes", "cold,artifact", "--out", str(out)])
+        assert rc == 0
+        rows = [e["row"] for e in boot.load_rows([str(out)])]
+        assert [r["mode"] for r in rows] == ["cold", "artifact"]
+        cold, warm = rows
+        assert cold["persistent_misses"] == len(ENTRIES)
+        assert warm["persistent_hits"] == len(ENTRIES)
+        assert warm["hit_rate"] == 1.0
+        assert warm["violations"] == []
+        assert warm["manifest_version"] == manifest["version"]
+        # the gate accepts its own recording
+        v = boot.boot_check(boot.load_rows([str(out)]))
+        assert v["verdict"] == "PASS"
